@@ -1,0 +1,26 @@
+"""kaito-tpu: a TPU-native AI toolchain operator.
+
+A from-scratch, TPU-first framework with the capabilities of KAITO
+(the Kubernetes AI Toolchain Operator): declarative APIs for LLM
+inference, fine-tuning and RAG that plan JAX device meshes over TPU
+slices, provision capacity, and serve models through a JAX/XLA/Pallas
+engine with continuous batching and paged attention.
+
+Layering (mirrors SURVEY.md §1, re-designed TPU-first):
+
+- ``kaito_tpu.api``        -- typed Workspace/InferenceSet/RAGEngine/... objects
+- ``kaito_tpu.sku``        -- TPU chip & slice catalog (v4/v5e/v5p/v6e)
+- ``kaito_tpu.models``     -- model metadata registry + presets + HF autogen
+- ``kaito_tpu.estimator``  -- HBM fit & slice-size estimation
+- ``kaito_tpu.parallel``   -- sharding planner: mesh + partition specs
+- ``kaito_tpu.engine``     -- JAX/Pallas serving engine (continuous batching)
+- ``kaito_tpu.tuning``     -- LoRA/QLoRA fine-tuning on TPU
+- ``kaito_tpu.rag``        -- RAG service (vector store, hybrid retrieval)
+- ``kaito_tpu.controllers``-- reconcilers (workspace, inferenceset, ...)
+- ``kaito_tpu.provision``  -- node provisioning backends (karpenter/byo/fake)
+- ``kaito_tpu.manifests``  -- k8s object rendering
+- ``kaito_tpu.runtime``    -- in-pod bootstrap: distributed init, probes
+- ``kaito_tpu.native``     -- C++ runtime components (allocators, indexes)
+"""
+
+__version__ = "0.1.0"

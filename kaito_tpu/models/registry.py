@@ -1,0 +1,67 @@
+"""Model preset registry.
+
+The analogue of the reference's plugin registry + lookup
+(``pkg/utils/plugin/plugin.go:37-133`` and ``GetModelByName``,
+``presets/workspace/models/vllm_model.go:116``): presets register by
+name; unknown names fall back to on-the-fly auto-generation from a HF
+config fetched by an injectable hook (the reference hits the HF Hub API
+directly at reconcile time).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Mapping, Optional
+
+from kaito_tpu.models.metadata import ModelMetadata
+
+_lock = threading.Lock()
+_registry: dict[str, ModelMetadata] = {}
+
+# Optional hook: hf_id -> config.json dict (or None).  Installed by the
+# controller when it has hub access; tests install fakes.
+ConfigFetcher = Callable[[str], Optional[Mapping]]
+_config_fetcher: Optional[ConfigFetcher] = None
+
+
+def register_model(md: ModelMetadata, replace: bool = False) -> None:
+    with _lock:
+        if md.name in _registry and not replace:
+            raise ValueError(f"model preset {md.name!r} already registered")
+        _registry[md.name] = md
+
+
+def is_valid_preset(name: str) -> bool:
+    return name in _registry
+
+
+def list_presets() -> list[str]:
+    with _lock:
+        return sorted(_registry)
+
+
+def set_config_fetcher(fetcher: Optional[ConfigFetcher]) -> None:
+    global _config_fetcher
+    _config_fetcher = fetcher
+
+
+def get_model_by_name(name: str) -> ModelMetadata:
+    """Look up a preset; auto-generate for unregistered HF ids when a
+    config fetcher is installed (reference behavior:
+    ``vllm_model.go:116-160`` falls through to ``GeneratePreset``)."""
+    with _lock:
+        md = _registry.get(name)
+    if md is not None:
+        return md
+    if _config_fetcher is not None and "/" in name:
+        cfg = _config_fetcher(name)
+        if cfg is not None:
+            from kaito_tpu.models.autogen import metadata_from_hf_config
+
+            md = metadata_from_hf_config(name, cfg)
+            register_model(md, replace=True)
+            return md
+    raise KeyError(
+        f"unknown model {name!r}; not a built-in preset and no config "
+        f"fetcher produced a HuggingFace config for it"
+    )

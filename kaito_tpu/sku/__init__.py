@@ -1,0 +1,11 @@
+from kaito_tpu.sku.catalog import (  # noqa: F401
+    TPUChipSpec,
+    TPUSliceSpec,
+    TPUSKUHandler,
+    GKETPUSKUHandler,
+    get_sku_handler,
+    parse_topology,
+    topology_chips,
+    get_tpu_config_from_node_labels,
+    CHIP_CATALOG,
+)

@@ -1,0 +1,1 @@
+from kaito_tpu.utils.quantity import Quantity, parse_quantity, format_quantity  # noqa: F401

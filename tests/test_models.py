@@ -1,0 +1,150 @@
+import pytest
+
+from kaito_tpu.models import (
+    AttentionKind,
+    get_model_by_name,
+    is_valid_preset,
+    list_presets,
+    metadata_from_hf_config,
+)
+from kaito_tpu.models.registry import set_config_fetcher
+
+GiB = 2**30
+
+
+def test_builtin_presets_present():
+    # Parity with the reference's supported_models.yaml preset names.
+    expected = [
+        "llama-3.1-8b-instruct", "llama-3.3-70b-instruct",
+        "deepseek-r1-0528", "deepseek-v3-0324",
+        "falcon-7b", "falcon-7b-instruct", "falcon-40b", "falcon-40b-instruct",
+        "mistral-7b", "mistral-7b-instruct",
+        "ministral-3-3b-instruct", "ministral-3-8b-instruct", "ministral-3-14b-instruct",
+        "mistral-large-3-675b-instruct",
+        "phi-2", "phi-3-mini-4k-instruct", "phi-3-mini-128k-instruct",
+        "phi-3-medium-4k-instruct", "phi-3-medium-128k-instruct",
+        "phi-3.5-mini-instruct", "phi-4-mini-instruct", "phi-4",
+        "qwen2.5-coder-7b-instruct", "qwen2.5-coder-32b-instruct",
+        "deepseek-r1-distill-qwen-14b", "deepseek-r1-distill-llama-8b",
+        "gemma-3-4b-instruct", "gemma-3-27b-instruct",
+        "gpt-oss-20b", "gpt-oss-120b",
+    ]
+    names = list_presets()
+    for name in expected:
+        assert name in names, name
+    assert all(is_valid_preset(n) for n in expected)
+
+
+def test_llama_8b_sizes():
+    md = get_model_by_name("llama-3.1-8b-instruct")
+    params = md.arch.param_count()
+    assert 7.5e9 < params < 8.5e9
+    # bf16 file ~15-16 GiB
+    assert 14 * GiB < md.file_bytes < 17 * GiB
+    # KV bytes/token: 2*32*8*128*2 = 131072
+    assert md.kv_bytes_per_token() == 131072
+    assert md.arch.attention_kind == AttentionKind.GQA
+    assert md.max_model_len == 131072
+
+
+def test_llama_70b_param_count():
+    md = get_model_by_name("llama-3.3-70b-instruct")
+    assert 68e9 < md.arch.param_count() < 72e9
+
+
+def test_phi4_matches_reference_catalog():
+    # reference model_catalog.yaml: phi-4 hidden 5120, layers 40, heads 40, kv 10
+    md = get_model_by_name("phi-4")
+    a = md.arch
+    assert (a.hidden_size, a.num_layers, a.num_heads, a.num_kv_heads) == (5120, 40, 40, 10)
+    assert md.max_model_len == 16384
+    assert 13e9 < a.param_count() < 16e9
+
+
+def test_deepseek_mla_kv_bytes():
+    md = get_model_by_name("deepseek-v3-0324")
+    assert md.arch.attention_kind == AttentionKind.MLA
+    # (512 + 64) * 61 layers * 2 bytes
+    assert md.kv_bytes_per_token() == (512 + 64) * 61 * 2
+    assert 600e9 < md.arch.param_count() < 720e9
+
+
+def test_falcon_mqa():
+    md = get_model_by_name("falcon-7b")
+    assert md.arch.attention_kind == AttentionKind.MQA
+    assert md.arch.num_kv_heads == 1
+
+
+def test_gpt_oss_moe():
+    md = get_model_by_name("gpt-oss-120b")
+    assert md.arch.num_experts == 128
+    assert md.quantization == "mxfp4"
+    assert 100e9 < md.arch.param_count() < 130e9
+
+
+def test_autogen_from_hf_config():
+    cfg = {
+        "architectures": ["Qwen2ForCausalLM"],
+        "model_type": "qwen2",
+        "vocab_size": 151936,
+        "hidden_size": 1536,
+        "num_hidden_layers": 28,
+        "num_attention_heads": 12,
+        "num_key_value_heads": 2,
+        "intermediate_size": 8960,
+        "max_position_embeddings": 32768,
+        "rope_theta": 1000000.0,
+    }
+    md = metadata_from_hf_config("Qwen/Qwen2.5-1.5B-Instruct", cfg)
+    assert md.arch.qkv_bias is True
+    assert md.arch.head_dim == 128
+    assert md.kv_bytes_per_token() == 2 * 28 * 2 * 128 * 2
+
+
+def test_autogen_rejects_unknown_arch():
+    with pytest.raises(ValueError):
+        metadata_from_hf_config("x/y", {"architectures": ["MambaForCausalLM"]})
+
+
+def test_unknown_model_uses_fetcher():
+    called = {}
+
+    def fetcher(hf_id):
+        called["id"] = hf_id
+        return {
+            "architectures": ["LlamaForCausalLM"],
+            "model_type": "llama",
+            "vocab_size": 32000,
+            "hidden_size": 512,
+            "num_hidden_layers": 4,
+            "num_attention_heads": 8,
+            "num_key_value_heads": 8,
+            "intermediate_size": 1024,
+        }
+
+    set_config_fetcher(fetcher)
+    try:
+        md = get_model_by_name("someorg/somemodel-7b")
+        assert called["id"] == "someorg/somemodel-7b"
+        assert md.arch.hidden_size == 512
+    finally:
+        set_config_fetcher(None)
+
+    with pytest.raises(KeyError):
+        get_model_by_name("not-a-preset")
+
+
+def test_gemma3_flags():
+    md = get_model_by_name("gemma-3-27b-instruct")
+    a = md.arch
+    assert a.norm_offset and a.pre_post_norm
+    assert a.sliding_window_pattern == 6
+    assert a.query_pre_attn_scalar == 168
+    assert a.tie_word_embeddings
+
+
+def test_disk_storage_rounding():
+    md = get_model_by_name("llama-3.1-8b-instruct")
+    disk = md.disk_storage_bytes()
+    assert disk % (10 * GiB) == 0
+    assert disk >= int(md.file_bytes * 2.5)

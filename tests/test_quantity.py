@@ -1,0 +1,21 @@
+import pytest
+
+from kaito_tpu.utils import Quantity, format_quantity, parse_quantity
+
+
+def test_parse():
+    assert parse_quantity("1Gi") == 2**30
+    assert parse_quantity("27.31Gi") == int(27.31 * 2**30) + 1  # ceil
+    assert parse_quantity("500Mi") == 500 * 2**20
+    assert parse_quantity("2k") == 2000
+    assert parse_quantity(42) == 42
+    with pytest.raises(ValueError):
+        parse_quantity("abc")
+
+
+def test_format_roundtrip():
+    assert format_quantity(2**30) == "1Gi"
+    assert format_quantity(10 * 2**30) == "10Gi"
+    assert Quantity("2Gi") + "1Gi" == Quantity("3Gi")
+    assert Quantity("1Gi") < "2Gi"
+    assert str(Quantity("1536Mi")) == "1.50Gi"

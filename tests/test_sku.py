@@ -1,0 +1,68 @@
+from kaito_tpu.sku import (
+    CHIP_CATALOG,
+    GKETPUSKUHandler,
+    TPUSliceSpec,
+    get_sku_handler,
+    get_tpu_config_from_node_labels,
+    parse_topology,
+    topology_chips,
+)
+
+GiB = 2**30
+
+
+def test_parse_topology():
+    assert parse_topology("2x4") == (2, 4)
+    assert parse_topology("4x4x8") == (4, 4, 8)
+    assert topology_chips("16x16") == 256
+    assert topology_chips("2x2x1") == 4
+
+
+def test_catalog_basics():
+    v5e = CHIP_CATALOG["v5e"]
+    assert v5e.hbm_bytes == 16 * GiB
+    assert v5e.ici_axes == 2
+    assert topology_chips(v5e.valid_topologies[-1]) <= v5e.max_chips
+    v5p = CHIP_CATALOG["v5p"]
+    assert v5p.hbm_bytes == 95 * GiB
+
+
+def test_topology_for_chips_picks_smallest():
+    v5e = CHIP_CATALOG["v5e"]
+    assert v5e.topology_for_chips(1) == "1x1"
+    assert v5e.topology_for_chips(5) == "2x4"
+    assert v5e.topology_for_chips(16) == "4x4"
+    assert v5e.topology_for_chips(10000) is None
+
+
+def test_hosts_for_topology():
+    v5e = CHIP_CATALOG["v5e"]
+    assert v5e.hosts_for_topology("4x4") == 2   # 16 chips / 8 per host
+    assert v5e.hosts_for_topology("1x1") == 1
+    v5p = CHIP_CATALOG["v5p"]
+    assert v5p.hosts_for_topology("4x4x4") == 16  # 64 chips / 4 per host
+
+
+def test_machine_type_lookup():
+    h = get_sku_handler("gke")
+    assert isinstance(h, GKETPUSKUHandler)
+    chip, per_vm = h.get_chip_config_by_machine_type("ct5lp-hightpu-4t")
+    assert chip.generation == "v5e" and per_vm == 4
+    assert h.get_chip_config_by_machine_type("n2-standard-4") is None
+
+
+def test_node_labels_roundtrip():
+    spec = TPUSliceSpec(chip=CHIP_CATALOG["v5e"], topology="4x4", machine_type="ct5lp-hightpu-4t")
+    labels = spec.node_selector()
+    back = get_tpu_config_from_node_labels(labels)
+    assert back is not None
+    assert back.chip.generation == "v5e"
+    assert back.num_chips == 16
+    assert back.total_hbm_bytes == 16 * 16 * GiB
+
+
+def test_default_machine_type():
+    h = GKETPUSKUHandler()
+    assert h.default_machine_type("v5e", "1x1") == "ct5lp-hightpu-1t"
+    # multi-host slice → full-density machine
+    assert h.default_machine_type("v5e", "4x8").endswith("8t")
