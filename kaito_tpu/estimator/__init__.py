@@ -1,0 +1,10 @@
+from kaito_tpu.estimator.estimator import (  # noqa: F401
+    HBM_UTILIZATION,
+    WEIGHT_EXPANSION,
+    PER_CHIP_OVERHEAD_BYTES,
+    SliceEstimate,
+    estimate_chip_count,
+    estimate_slice,
+    max_kv_tokens,
+    weight_bytes,
+)

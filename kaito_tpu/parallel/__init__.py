@@ -1,0 +1,17 @@
+from kaito_tpu.parallel.plan import (  # noqa: F401
+    AXIS_DATA,
+    AXIS_EXPERT,
+    AXIS_FSDP,
+    AXIS_PIPELINE,
+    AXIS_SEQUENCE,
+    AXIS_TENSOR,
+    MeshSpec,
+    ParallelPlan,
+    plan_parallelism,
+)
+from kaito_tpu.parallel.sharding import (  # noqa: F401
+    PartitionRules,
+    SERVE_RULES,
+    TRAIN_RULES,
+    logical_to_pspec,
+)

@@ -1,0 +1,91 @@
+"""Logical-axis sharding rules: how parameter/activation dimensions map
+onto mesh axes.
+
+The engine and trainer annotate every array with *logical* axis names
+("vocab", "heads", "intermediate", ...); these rules translate them to
+``jax.sharding.PartitionSpec`` over the planned mesh.  This is the
+GSPMD-native replacement for the reference's flag plumbing — instead of
+telling vLLM ``--tensor-parallel-size``, the partitioning is carried by
+the arrays themselves and XLA inserts the collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Optional, Sequence, Union
+
+from jax.sharding import PartitionSpec
+
+AxisAssignment = Union[None, str, tuple[str, ...]]
+
+
+class PartitionRules:
+    """Ordered logical-name → mesh-axis mapping."""
+
+    def __init__(self, rules: Mapping[str, AxisAssignment]):
+        self.rules = dict(rules)
+
+    def assignment(self, logical: Optional[str]) -> AxisAssignment:
+        if logical is None:
+            return None
+        return self.rules.get(logical)
+
+    def spec(self, logical_axes: Sequence[Optional[str]]) -> PartitionSpec:
+        return logical_to_pspec(logical_axes, self)
+
+
+def logical_to_pspec(
+    logical_axes: Sequence[Optional[str]], rules: PartitionRules
+) -> PartitionSpec:
+    parts: list[AxisAssignment] = []
+    used: set[str] = set()
+    for name in logical_axes:
+        a = rules.assignment(name)
+        if a is None:
+            parts.append(None)
+            continue
+        axes = (a,) if isinstance(a, str) else tuple(a)
+        fresh = tuple(x for x in axes if x not in used)
+        used.update(fresh)
+        if not fresh:
+            parts.append(None)
+        elif len(fresh) == 1:
+            parts.append(fresh[0])
+        else:
+            parts.append(fresh)
+    # Trim trailing Nones for canonical specs.
+    while parts and parts[-1] is None:
+        parts.pop()
+    return PartitionSpec(*parts)
+
+
+# Serving (inference): Megatron-style TP. Weights are sharded on the
+# head/intermediate/vocab dimensions over the tensor axis; activations
+# batch over data.
+SERVE_RULES = PartitionRules({
+    "batch": "data",
+    "vocab": "tensor",
+    "embed": None,
+    "heads": "tensor",
+    "kv_heads": "tensor",
+    "head_dim": None,
+    "intermediate": "tensor",
+    "expert": "tensor",
+    "layers": None,
+    "kv_pages": None,
+    "seq": None,
+})
+
+# Training: FSDP shards the non-TP weight dimension; batch spreads over
+# (data, fsdp); sequence axis shards the length dim for ring attention.
+TRAIN_RULES = PartitionRules({
+    "batch": ("data", "fsdp"),
+    "vocab": "tensor",
+    "embed": "fsdp",
+    "heads": "tensor",
+    "kv_heads": "tensor",
+    "head_dim": None,
+    "intermediate": "tensor",
+    "expert": "expert",
+    "layers": None,
+    "seq": "sequence",
+})

@@ -1,0 +1,71 @@
+import pytest
+
+from kaito_tpu.estimator import (
+    estimate_chip_count,
+    estimate_slice,
+    max_kv_tokens,
+    weight_bytes,
+)
+from kaito_tpu.models import get_model_by_name
+from kaito_tpu.sku import CHIP_CATALOG
+
+GiB = 2**30
+
+
+def test_llama70b_on_v5e_matches_north_star():
+    """BASELINE.json north star: Llama-3-70B serves on a v5e-16 slice."""
+    md = get_model_by_name("llama-3.3-70b-instruct")
+    v5e = CHIP_CATALOG["v5e"]
+    est = estimate_slice(md, v5e, max_model_len=8192)
+    assert est.topology == "4x4"
+    assert est.num_chips == 16
+    assert est.max_kv_tokens > 100_000  # room for real batches
+    # weights ~141GiB loaded over 16 chips => < 9GiB/chip
+    assert est.per_chip_weights < 9.5 * GiB
+
+
+def test_small_model_single_chip():
+    md = get_model_by_name("phi-4-mini-instruct")
+    v5e = CHIP_CATALOG["v5e"]
+    assert estimate_chip_count(md, v5e, max_model_len=4096) == 1
+    est = estimate_slice(md, v5e, max_model_len=4096)
+    assert est.topology == "1x1"
+
+
+def test_context_length_raises_chip_count():
+    md = get_model_by_name("llama-3.1-8b-instruct")
+    v5e = CHIP_CATALOG["v5e"]
+    small = estimate_chip_count(md, v5e, max_model_len=2048)
+    big = estimate_chip_count(md, v5e, max_model_len=131072)
+    assert big >= small
+    # 128k context KV alone = 131072 * 131072 B = 16GiB > one v5e
+    assert big >= 2
+
+
+def test_quantization_shrinks_weights():
+    md = get_model_by_name("llama-3.3-70b-instruct")
+    assert weight_bytes(md, "int8") < weight_bytes(md, "") * 0.55
+    assert weight_bytes(md, "int4") < weight_bytes(md, "int8")
+
+
+def test_too_big_model_raises():
+    md = get_model_by_name("deepseek-v3-0324")
+    v5e = CHIP_CATALOG["v5e"]
+    # 671B params bf16 won't fit the largest v5e slice (256 chips) with
+    # full 160k context in one stage... actually 256*~13.5GiB = 3.4TiB,
+    # weights are ~1.4TiB, so it fits. Use a tiny generation cap instead.
+    est = estimate_slice(md, v5e)
+    assert est.num_chips >= 128
+
+
+def test_max_kv_tokens_monotone_in_chips():
+    md = get_model_by_name("llama-3.1-8b-instruct")
+    v5e = CHIP_CATALOG["v5e"]
+    assert max_kv_tokens(md, v5e, 4) > max_kv_tokens(md, v5e, 2) > 0
+
+
+def test_min_chips_floor():
+    md = get_model_by_name("phi-4-mini-instruct")
+    v5e = CHIP_CATALOG["v5e"]
+    est = estimate_slice(md, v5e, max_model_len=4096, min_chips=4)
+    assert est.num_chips == 4
