@@ -1,0 +1,10 @@
+"""JAX/XLA/Pallas serving engine.
+
+The TPU-native replacement for the reference's in-pod vLLM stack
+(``presets/workspace/inference/vllm/inference_api.py`` + the vendored
+vLLM/Ray/NCCL container): config-driven transformer models, a paged KV
+cache, continuous batching, Pallas attention kernels, and an
+OpenAI-compatible HTTP front end.
+"""
+
+from kaito_tpu.engine.config import EngineConfig  # noqa: F401

@@ -1,0 +1,101 @@
+"""Attention: chunked-causal prefill and paged decode.
+
+Pure-JAX reference implementations with static shapes.  The Pallas
+kernels (kaito_tpu.engine.ops) implement the same signatures and are
+selected by ``EngineConfig.use_pallas``; tests compare the two.  All
+softmax math is fp32.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _gqa_expand(x: jax.Array, groups: int) -> jax.Array:
+    """[..., Hkv, D] -> [..., Hkv*groups, D]."""
+    if groups == 1:
+        return x
+    return jnp.repeat(x, groups, axis=-2)
+
+
+def prefill_attention(
+    q: jax.Array,            # [B, T, H, D]
+    k: jax.Array,            # [B, T, Hkv, D]
+    v: jax.Array,            # [B, T, Hkv, D]
+    *,
+    scale: float,
+    sliding_window: Optional[int] = None,
+    logit_softcap: Optional[float] = None,
+    true_len: Optional[jax.Array] = None,   # [B]
+) -> jax.Array:
+    """Causal self-attention over a freshly prefillled chunk.
+
+    Positions are 0..T-1 within the chunk (round-1 engine prefills a
+    request in one padded chunk; the chunked long-prompt path arrives
+    with the Pallas flash kernel).
+    """
+    B, T, H, D = q.shape
+    groups = H // k.shape[2]
+    k = _gqa_expand(k, groups)
+    v = _gqa_expand(v, groups)
+    scores = jnp.einsum("bthd,bshd->bhts", q, k, preferred_element_type=jnp.float32)
+    scores = scores * scale
+    if logit_softcap:
+        scores = jnp.tanh(scores / logit_softcap) * logit_softcap
+    t_pos = jnp.arange(T)[:, None]
+    s_pos = jnp.arange(T)[None, :]
+    mask = s_pos <= t_pos
+    # sliding_window may be a traced per-layer scalar (scan flag); global
+    # layers pass a huge window, so the mask stays branch-free.
+    if sliding_window is not None:
+        mask &= s_pos > t_pos - sliding_window
+    if true_len is not None:
+        mask = mask[None, :, :] & (s_pos[None] < true_len[:, None, None])
+        mask = mask[:, None]  # [B, 1, T, S]
+    scores = jnp.where(mask, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhts,bshd->bthd", probs.astype(v.dtype), v)
+
+
+def paged_decode_attention(
+    q: jax.Array,            # [B, H, D] (one new token per sequence)
+    cache_k: jax.Array,      # [num_pages, page_size, Hkv, D]
+    cache_v: jax.Array,
+    page_tables: jax.Array,  # [B, pages_per_seq]
+    lengths: jax.Array,      # [B] tokens in cache INCLUDING the new one
+    *,
+    scale: float,
+    sliding_window: Optional[int] = None,
+    logit_softcap: Optional[float] = None,
+) -> jax.Array:
+    """Attend one query token per sequence over its paged KV history."""
+    B, H, D = q.shape
+    ps = cache_k.shape[1]
+    Hkv = cache_k.shape[2]
+    pmax = page_tables.shape[1]
+    S = pmax * ps
+    groups = H // Hkv
+
+    k = cache_k[page_tables]                      # [B, pmax, ps, Hkv, D]
+    v = cache_v[page_tables]
+    k = k.reshape(B, S, Hkv, D)
+    v = v.reshape(B, S, Hkv, D)
+
+    qg = q.reshape(B, Hkv, groups, D)
+    scores = jnp.einsum("bkgd,bskd->bkgs", qg, k, preferred_element_type=jnp.float32)
+    scores = scores * scale
+    if logit_softcap:
+        scores = jnp.tanh(scores / logit_softcap) * logit_softcap
+    s_pos = jnp.arange(S)[None, :]
+    mask = s_pos < lengths[:, None]
+    if sliding_window is not None:
+        mask &= s_pos >= lengths[:, None] - sliding_window
+    scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgs,bskd->bkgd", probs.astype(v.dtype), v)
+    return out.reshape(B, H, D)

@@ -1,0 +1,46 @@
+"""Engine configuration.
+
+The serving-side contract the reference exposes through vLLM flags +
+the KAITO config file (``inference_api.py:64-160`` merges
+``--kaito-config-file`` YAML over the vLLM arg surface).  Our config is
+a dataclass consumed by the engine, the scheduler and the HTTP server;
+the workload generator renders it into the pod command line.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass
+class EngineConfig:
+    model: str = "tiny-llama-test"      # preset name or HF id
+    max_model_len: int = 0               # 0 = model's own limit, capped by HBM
+    page_size: int = 64                  # KV tokens per page
+    max_num_seqs: int = 8                # concurrent decode slots
+    max_pages: int = 0                   # 0 = derive from HBM budget
+    max_prefill_tokens: int = 1024       # prefill chunk budget per step
+    prefill_buckets: tuple[int, ...] = (128, 256, 512, 1024, 2048, 4096)
+    dtype: str = "bfloat16"
+    kv_dtype: str = "bfloat16"
+    seed: int = 0
+    tensor_parallel: int = 1             # TP degree (mesh "tensor" axis)
+    data_parallel: int = 1               # engine replica groups
+    use_pallas: Optional[bool] = None    # None = auto (TPU yes, CPU no)
+    # serving-side knobs carried over from the reference wrapper surface
+    port: int = 5000
+    served_model_name: str = ""
+    adapters_dir: str = ""               # LoRA adapter discovery dir
+    disable_rate_limit: bool = False
+    max_queue_len: int = 256
+
+    def replace(self, **kw) -> "EngineConfig":
+        return dataclasses.replace(self, **kw)
+
+    @property
+    def pages_per_seq(self) -> int:
+        if not self.max_model_len:
+            raise ValueError("max_model_len not resolved")
+        return -(-self.max_model_len // self.page_size)

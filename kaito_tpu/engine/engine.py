@@ -1,0 +1,453 @@
+"""The inference engine: continuous batching over a paged KV cache.
+
+This is the tokens/s hot loop — the TPU counterpart of the vLLM engine
+step loop the reference leans on (SURVEY.md §3.1 "HOT LOOP").  Design:
+
+- Fixed decode *slots* (``max_num_seqs``).  One compiled decode step
+  advances every slot each iteration; inactive slots write to the null
+  page and their samples are discarded.  Static shapes, one program.
+- Prefill runs per admitted request, padded to a bucket length, writing
+  straight into the request's pages (no copy into the decode state —
+  the page table IS the hand-off).
+- Pages come from a free-list allocator; a request is admitted only
+  when its worst-case page need (prompt + max_tokens) is available, so
+  there is no mid-flight preemption in round 1.
+- jit with donated cache/state keeps HBM traffic at the theoretical
+  minimum; per-bucket programs are compiled on first use and cached.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kaito_tpu.engine.config import EngineConfig
+from kaito_tpu.engine.kv_cache import KVCache, create_kv_cache
+from kaito_tpu.engine.model import TransformerLM
+from kaito_tpu.engine.sampler import SamplingState, sample
+from kaito_tpu.engine.tokenizer import load_tokenizer
+from kaito_tpu.estimator.estimator import PER_CHIP_OVERHEAD_BYTES, HBM_UTILIZATION
+from kaito_tpu.models.metadata import ModelMetadata
+from kaito_tpu.models.registry import get_model_by_name
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class SamplingParams:
+    max_tokens: int = 128
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 1.0
+    stop_token_ids: tuple[int, ...] = ()
+    seed: int = 0
+    ignore_eos: bool = False
+
+
+@dataclass
+class Request:
+    req_id: str
+    prompt_tokens: list[int]
+    params: SamplingParams
+    out: "queue.SimpleQueue[Optional[int]]" = field(default_factory=queue.SimpleQueue)
+    output_tokens: list[int] = field(default_factory=list)
+    submit_time: float = field(default_factory=time.monotonic)
+    first_token_time: Optional[float] = None
+    finish_time: Optional[float] = None
+    finish_reason: str = ""
+    aborted: bool = False
+
+    def stream(self):
+        """Yield token ids until completion."""
+        while True:
+            tok = self.out.get()
+            if tok is None:
+                return
+            yield tok
+
+
+class PageAllocator:
+    """Free-list page allocator (page 0 reserved as the null page).
+
+    A C++ twin lives in kaito_tpu/native for the radix-tree prefix cache;
+    the free list itself is not the bottleneck.
+    """
+
+    def __init__(self, num_pages: int):
+        self.free = list(range(num_pages - 1, 0, -1))
+        self.num_pages = num_pages
+
+    @property
+    def available(self) -> int:
+        return len(self.free)
+
+    def alloc(self, n: int) -> list[int]:
+        if n > len(self.free):
+            raise MemoryError(f"need {n} pages, have {len(self.free)}")
+        taken = self.free[-n:][::-1]
+        del self.free[len(self.free) - n:]
+        return taken
+
+    def release(self, pages: list[int]) -> None:
+        self.free.extend(reversed(pages))
+
+
+@dataclass
+class _Slot:
+    request: Optional[Request] = None
+    pages: list[int] = field(default_factory=list)
+    position: int = 0          # next token position (== current length)
+    remaining: int = 0
+
+
+class InferenceEngine:
+    """Synchronous engine core; the HTTP server drives it via a thread."""
+
+    def __init__(
+        self,
+        cfg: EngineConfig,
+        metadata: Optional[ModelMetadata] = None,
+        params=None,
+        mesh=None,
+    ):
+        self.cfg = cfg
+        self.md = metadata or get_model_by_name(cfg.model)
+        arch = self.md.arch
+        self.dtype = jnp.dtype(cfg.dtype)
+        self.model = TransformerLM(arch, dtype=self.dtype)
+        self.tokenizer = load_tokenizer(self.md.hf_id, arch.vocab_size)
+        self.mesh = mesh
+
+        if not cfg.max_model_len:
+            cfg.max_model_len = min(self.md.max_model_len, 8192)
+        self.pages_per_seq = cfg.pages_per_seq
+        # buckets must cover any admissible prompt (< max_model_len)
+        self.buckets = tuple(sorted(
+            {b for b in cfg.prefill_buckets if b < cfg.max_model_len}
+            | {cfg.max_model_len}))
+        num_pages = cfg.max_pages or self._derive_max_pages()
+        num_pages = max(num_pages, cfg.max_num_seqs * self.pages_per_seq // 4 + 2)
+        self.cache = create_kv_cache(arch, num_pages, cfg.page_size,
+                                     jnp.dtype(cfg.kv_dtype))
+        logger.info("KV cache: %d pages x %d tokens (%.2f GiB)",
+                    num_pages, cfg.page_size,
+                    2 * self.cache.k.nbytes / 2**30)
+
+        self.params = params if params is not None else self._init_params()
+        self.allocator = PageAllocator(num_pages)
+        S = cfg.max_num_seqs
+        self.slots = [_Slot() for _ in range(S)]
+        self.page_tables = np.zeros((S, self.pages_per_seq), np.int32)
+        self.positions = np.zeros((S,), np.int32)
+        self.active = np.zeros((S,), bool)
+        self.sampling = SamplingState.create(S, cfg.seed)
+        self.last_tokens = np.zeros((S,), np.int32)
+
+        self.waiting: "queue.SimpleQueue[Request]" = queue.SimpleQueue()
+        self._waiting_count = 0
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+        # metrics (scraped by the server's /metrics)
+        self.counters = {
+            "prompt_tokens_total": 0,
+            "generation_tokens_total": 0,
+            "requests_total": 0,
+            "requests_finished_total": 0,
+            "prefill_steps_total": 0,
+            "decode_steps_total": 0,
+        }
+
+        self._decode_fn = self._build_decode_fn()
+        self._prefill_fns: dict[int, object] = {}
+        self._sample_one = jax.jit(sample)
+
+    # ------------------------------------------------------------------
+    # Construction helpers
+    # ------------------------------------------------------------------
+
+    def _init_params(self):
+        logger.info("initializing synthetic weights for %s", self.md.name)
+        t0 = time.monotonic()
+        with jax.default_device(jax.devices()[0]):
+            params = jax.jit(self.model.init_params)(jax.random.PRNGKey(self.cfg.seed))
+        jax.block_until_ready(params)
+        logger.info("weights ready in %.1fs (%.2f GiB)",
+                    time.monotonic() - t0,
+                    sum(x.nbytes for x in jax.tree.leaves(params)) / 2**30)
+        return params
+
+    def _derive_max_pages(self) -> int:
+        """Size the page pool from free HBM (the engine-side analogue of
+        the reference's gpu-memory-utilization default computed from
+        torch.cuda.mem_get_info, inference_api.py)."""
+        dev = jax.devices()[0]
+        bpt = self.md.kv_bytes_per_token(jnp.dtype(self.cfg.kv_dtype).itemsize)
+        try:
+            stats = dev.memory_stats()
+            limit = stats["bytes_limit"] * HBM_UTILIZATION
+            free = limit - stats["bytes_in_use"]
+        except Exception:
+            # CPU / unknown backend: enough for max_num_seqs full contexts
+            return self.cfg.max_num_seqs * self.pages_per_seq + 1
+        weights = self.md.arch.param_count() * self.dtype.itemsize
+        free = free - weights - PER_CHIP_OVERHEAD_BYTES
+        pages = int(max(free, 0) // (bpt * self.cfg.page_size))
+        cap = self.cfg.max_num_seqs * self.pages_per_seq
+        return max(2, min(pages, cap) + 1)
+
+    # ------------------------------------------------------------------
+    # Compiled steps
+    # ------------------------------------------------------------------
+
+    def _build_decode_fn(self):
+        model = self.model
+
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def decode_step(params, cache, sampling, tokens, positions, page_tables, active):
+            cache, logits = model.decode(params, cache, tokens, positions,
+                                         page_tables, active)
+            next_tokens, sampling = sample(logits, sampling)
+            return cache, sampling, next_tokens
+
+        return decode_step
+
+    def _prefill_fn(self, bucket: int):
+        fn = self._prefill_fns.get(bucket)
+        if fn is None:
+            model = self.model
+
+            @partial(jax.jit, donate_argnums=(1,))
+            def prefill_step(params, cache, tokens, true_lens, page_tables):
+                cache, logits, _ = model.prefill(params, cache, tokens,
+                                                 true_lens, page_tables)
+                return cache, logits
+
+            fn = prefill_step
+            self._prefill_fns[bucket] = fn
+        return fn
+
+    def _bucket(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        raise ValueError(f"prompt length {n} exceeds largest bucket {self.buckets[-1]}")
+
+    # ------------------------------------------------------------------
+    # Public API
+    # ------------------------------------------------------------------
+
+    @property
+    def num_waiting(self) -> int:
+        return self._waiting_count
+
+    @property
+    def num_running(self) -> int:
+        return int(self.active.sum())
+
+    def submit(self, prompt_tokens: list[int], params: SamplingParams,
+               req_id: Optional[str] = None) -> Request:
+        if len(prompt_tokens) >= self.cfg.max_model_len:
+            raise ValueError(
+                f"prompt length {len(prompt_tokens)} exceeds max_model_len "
+                f"{self.cfg.max_model_len}")
+        req = Request(req_id or f"req-{self.counters['requests_total']}",
+                      list(prompt_tokens), params)
+        with self._lock:
+            self.counters["requests_total"] += 1
+            self._waiting_count += 1
+        self.waiting.put(req)
+        self._wake.set()
+        return req
+
+    def generate(self, prompt: str, params: Optional[SamplingParams] = None) -> str:
+        """Blocking single-request helper (tests, benchmark probe)."""
+        params = params or SamplingParams()
+        toks = self.tokenizer.encode(prompt)
+        req = self.submit(toks, params)
+        out = list(req.stream())
+        return self.tokenizer.decode(out)
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="engine-loop")
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        self._wake.set()
+        if self._thread:
+            self._thread.join(timeout=30)
+
+    # ------------------------------------------------------------------
+    # Scheduler loop
+    # ------------------------------------------------------------------
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                did_work = self.step()
+            except Exception:
+                # A scheduler-loop failure must not strand waiting clients.
+                logger.exception("engine loop failure; failing in-flight requests")
+                self._fail_all()
+                continue
+            if not did_work:
+                self._wake.wait(timeout=0.05)
+                self._wake.clear()
+
+    def _fail_all(self):
+        for i, slot in enumerate(self.slots):
+            if slot.request is not None:
+                slot.request.finish_reason = "error"
+                slot.request.out.put(None)
+                self.allocator.release(slot.pages)
+                slot.request, slot.pages = None, []
+                self.active[i] = False
+        while True:
+            try:
+                req = self.waiting.get_nowait()
+            except queue.Empty:
+                break
+            with self._lock:
+                self._waiting_count -= 1
+            req.finish_reason = "error"
+            req.out.put(None)
+
+    def step(self) -> bool:
+        """One scheduler iteration. Returns False when idle."""
+        admitted = self._try_admit()
+        if self.active.any():
+            self._decode_once()
+            return True
+        return admitted
+
+    def _try_admit(self) -> bool:
+        """Admit at most one waiting request into a free slot (prefill)."""
+        free_slot = next((i for i, s in enumerate(self.slots) if s.request is None), None)
+        if free_slot is None:
+            return False
+        try:
+            req = self.waiting.get_nowait()
+        except queue.Empty:
+            return False
+        with self._lock:
+            self._waiting_count -= 1
+        if req.aborted:
+            req.out.put(None)
+            return True
+
+        n = len(req.prompt_tokens)
+        max_total = min(n + req.params.max_tokens, self.cfg.max_model_len)
+        pages_needed = -(-max_total // self.cfg.page_size)
+        if pages_needed > self.allocator.available:
+            # not enough KV memory: requeue and stall admission
+            self.waiting.put(req)
+            with self._lock:
+                self._waiting_count += 1
+            return False
+
+        pages = self.allocator.alloc(pages_needed)
+        bucket = self._bucket(n)
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :n] = req.prompt_tokens
+        table = np.zeros((self.pages_per_seq,), np.int32)
+        table[:pages_needed] = pages
+
+        fn = self._prefill_fn(bucket)
+        self.cache, logits = fn(self.params, self.cache,
+                                jnp.asarray(tokens),
+                                jnp.asarray([n], np.int32),
+                                jnp.asarray(table[None]))
+        self.counters["prefill_steps_total"] += 1
+        self.counters["prompt_tokens_total"] += n
+
+        # first sampled token
+        self.sampling = self.sampling.set_slot(
+            free_slot, temperature=req.params.temperature,
+            top_k=req.params.top_k, top_p=req.params.top_p,
+            seed=req.params.seed or self.counters["requests_total"])
+        sub = SamplingState(
+            temperature=self.sampling.temperature[free_slot:free_slot + 1],
+            top_k=self.sampling.top_k[free_slot:free_slot + 1],
+            top_p=self.sampling.top_p[free_slot:free_slot + 1],
+            key=self.sampling.key[free_slot:free_slot + 1])
+        tok, sub = self._sample_one(logits, sub)
+        self.sampling = SamplingState(
+            temperature=self.sampling.temperature,
+            top_k=self.sampling.top_k,
+            top_p=self.sampling.top_p,
+            key=self.sampling.key.at[free_slot].set(sub.key[0]))
+        first = int(tok[0])
+
+        slot = self.slots[free_slot]
+        slot.request = req
+        slot.pages = pages
+        slot.position = n
+        slot.remaining = min(req.params.max_tokens,
+                             self.cfg.max_model_len - n)
+        self.page_tables[free_slot] = table
+        self.positions[free_slot] = n
+        self.active[free_slot] = True
+        self.last_tokens[free_slot] = first
+
+        req.first_token_time = time.monotonic()
+        self._emit(free_slot, first)
+        return True
+
+    def _decode_once(self):
+        cache, sampling, next_tokens = self._decode_fn(
+            self.params, self.cache, self.sampling,
+            jnp.asarray(self.last_tokens),
+            jnp.asarray(self.positions),
+            jnp.asarray(self.page_tables),
+            jnp.asarray(self.active))
+        self.cache = cache
+        self.sampling = sampling
+        self.counters["decode_steps_total"] += 1
+        toks = np.asarray(next_tokens)
+        for i, slot in enumerate(self.slots):
+            if not self.active[i]:
+                continue
+            self.positions[i] += 1
+            slot.position += 1
+            self._emit(i, int(toks[i]))
+            self.last_tokens[i] = int(toks[i])
+
+    def _emit(self, slot_idx: int, token: int):
+        """Deliver one generated token; retire the slot when finished."""
+        slot = self.slots[slot_idx]
+        req = slot.request
+        assert req is not None
+        req.output_tokens.append(token)
+        slot.remaining -= 1
+        self.counters["generation_tokens_total"] += 1
+
+        eos = self.tokenizer.eos_token_id
+        stop_ids = set(req.params.stop_token_ids)
+        if eos is not None and not req.params.ignore_eos:
+            stop_ids.add(eos)
+        finished = token in stop_ids or slot.remaining <= 0 or req.aborted
+        if token not in stop_ids:
+            req.out.put(token)
+        if finished:
+            req.finish_reason = "stop" if token in stop_ids else "length"
+            req.finish_time = time.monotonic()
+            req.out.put(None)
+            self.allocator.release(slot.pages)
+            slot.request = None
+            slot.pages = []
+            self.active[slot_idx] = False
+            self.counters["requests_finished_total"] += 1

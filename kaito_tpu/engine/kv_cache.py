@@ -1,0 +1,90 @@
+"""Paged KV cache.
+
+The engine's KV memory is a global page pool per layer —
+``[num_layers, num_pages, page_size, kv_heads, head_dim]`` — addressed
+through per-sequence page tables, vLLM-style but with static shapes
+throughout so XLA compiles one program per (bucket, batch) shape.  The
+reference delegates this entirely to vLLM's PagedAttention
+(SURVEY.md §2.3); on TPU we own it.
+
+Page 0 is reserved as the null page: unused page-table slots point at
+it, so gathers are always in-bounds and masking is done by length, not
+by index validity.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from kaito_tpu.models.metadata import ModelArch
+
+NULL_PAGE = 0
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class KVCache:
+    """Stacked per-layer page pools (a pytree; donate on every step)."""
+
+    k: jax.Array  # [L, num_pages, page_size, kv_heads, head_dim]
+    v: jax.Array
+
+    @property
+    def num_pages(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def page_size(self) -> int:
+        return self.k.shape[2]
+
+
+def create_kv_cache(
+    arch: ModelArch,
+    num_pages: int,
+    page_size: int,
+    dtype: jnp.dtype = jnp.bfloat16,
+) -> KVCache:
+    shape = (arch.num_layers, num_pages, page_size, arch.num_kv_heads, arch.head_dim)
+    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+def write_prefill_tokens(
+    cache_layer: jax.Array,       # [num_pages, page_size, Hkv, D]
+    new: jax.Array,               # [B, T, Hkv, D]
+    page_tables: jax.Array,       # [B, pages_per_seq] int32
+    start_pos: jax.Array,         # [B] sequence position of new[:, 0]
+    true_lens: jax.Array,         # [B] valid tokens per row; pad -> null page
+    page_size: int,
+) -> jax.Array:
+    """Scatter a batch of prefill chunks into their pages in one flat
+    scatter (a vmap would fork the shared pool buffer per row)."""
+    B, T = new.shape[:2]
+    t = jnp.arange(T, dtype=jnp.int32)[None, :]
+    pos = start_pos[:, None] + t                                  # [B, T]
+    page_idx = jnp.take_along_axis(page_tables, pos // page_size, axis=1)
+    valid = t < true_lens[:, None]
+    page_idx = jnp.where(valid, page_idx, NULL_PAGE)
+    offset = pos % page_size
+    flat = new.reshape(B * T, *new.shape[2:])
+    return cache_layer.at[page_idx.reshape(-1), offset.reshape(-1)].set(flat)
+
+
+def write_decode_tokens(
+    cache_layer: jax.Array,       # [num_pages, page_size, Hkv, D]
+    new: jax.Array,               # [B, Hkv, D] one token per sequence
+    page_tables: jax.Array,       # [B, pages_per_seq]
+    positions: jax.Array,         # [B] current position of each new token
+    page_size: int,
+    active: Optional[jax.Array] = None,  # [B] bool; inactive rows hit page 0
+) -> jax.Array:
+    B = new.shape[0]
+    page_idx = jnp.take_along_axis(
+        page_tables, (positions // page_size)[:, None], axis=1)[:, 0]
+    if active is not None:
+        page_idx = jnp.where(active, page_idx, NULL_PAGE)
+    offset = positions % page_size
+    return cache_layer.at[page_idx, offset].set(new)

@@ -1,0 +1,387 @@
+"""Config-driven transformer LM.
+
+One implementation covers the dense families (llama / mistral / qwen2 /
+phi-3 / phi-4 / gemma-3 / falcon / phi-2) and token-choice MoE
+(mixtral / gpt-oss style); layers run under ``lax.scan`` over stacked
+parameters so an 80-layer model compiles as one layer, and per-layer
+heterogeneity (sliding vs global attention, local vs global RoPE) rides
+along as scanned flag arrays.  Dense-prefix MoE models (DeepSeek-style
+``first_k_dense_replace``) split into two scans.
+
+This replaces the model zoo the reference gets for free from vLLM
+(SURVEY.md §2.2, §7 step 3); parameters are plain pytrees whose logical
+axes map onto the planner's mesh via kaito_tpu.parallel.sharding.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import cached_property, partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from kaito_tpu.engine import attention as attn
+from kaito_tpu.engine import nn
+from kaito_tpu.engine.kv_cache import KVCache, write_decode_tokens, write_prefill_tokens
+from kaito_tpu.models.metadata import AttentionKind, ModelArch
+
+VOCAB_ALIGN = 128
+_BIG_WINDOW = 1 << 30
+
+
+@dataclass(frozen=True)
+class LayerGroup:
+    name: str          # "dense" | "moe"
+    start: int
+    count: int
+    moe: bool
+
+
+def _layer_groups(arch: ModelArch) -> tuple[LayerGroup, ...]:
+    if arch.num_experts > 0 and arch.moe_layer_start > 0:
+        k = arch.moe_layer_start
+        return (
+            LayerGroup("dense", 0, k, False),
+            LayerGroup("moe", k, arch.num_layers - k, True),
+        )
+    if arch.num_experts > 0:
+        return (LayerGroup("moe", 0, arch.num_layers, True),)
+    return (LayerGroup("dense", 0, arch.num_layers, False),)
+
+
+class TransformerLM:
+    """Functional model: all state lives in explicit params/cache trees."""
+
+    def __init__(self, arch: ModelArch, dtype=jnp.bfloat16):
+        if arch.attention_kind == AttentionKind.MLA:
+            raise NotImplementedError(
+                "MLA attention (DeepSeek V2/V3) lands with a dedicated kernel; "
+                "distilled llama/qwen checkpoints serve today")
+        self.arch = arch
+        self.dtype = dtype
+        self.groups = _layer_groups(arch)
+        self.vocab_padded = -(-arch.vocab_size // VOCAB_ALIGN) * VOCAB_ALIGN
+        # rope tables are concrete constants; computing them lazily inside
+        # a traced scan body would cache tracers
+        self._inv_freq_global = nn.rope_frequencies(arch)
+        self._inv_freq_local = self._make_inv_freq_local()
+
+    # ------------------------------------------------------------------
+    # Parameter construction
+    # ------------------------------------------------------------------
+
+    def _layer_specs(self, moe: bool) -> dict[str, tuple[tuple[int, ...], tuple]]:
+        a = self.arch
+        E, H, Hkv, D, I = (a.hidden_size, a.num_heads, a.num_kv_heads,
+                           a.head_dim, a.intermediate_size)
+        specs: dict[str, tuple[tuple[int, ...], tuple]] = {
+            "attn_norm": ((E,), ("embed",)),
+            "q": ((E, H * D), ("embed", "heads")),
+            "k": ((E, Hkv * D), ("embed", "kv_heads")),
+            "v": ((E, Hkv * D), ("embed", "kv_heads")),
+            "o": ((H * D, E), ("heads", "embed")),
+        }
+        if a.qkv_bias or a.linear_bias:
+            specs.update({
+                "q_bias": ((H * D,), ("heads",)),
+                "k_bias": ((Hkv * D,), ("kv_heads",)),
+                "v_bias": ((Hkv * D,), ("kv_heads",)),
+            })
+        if a.linear_bias:
+            specs["o_bias"] = ((E,), ("embed",))
+        if a.qk_norm:
+            specs["q_norm"] = ((D,), (None,))
+            specs["k_norm"] = ((D,), (None,))
+        if a.norm_type == "layernorm":
+            specs["attn_norm_bias"] = ((E,), ("embed",))
+        if not a.parallel_residual:
+            specs["mlp_norm"] = ((E,), ("embed",))
+            if a.norm_type == "layernorm":
+                specs["mlp_norm_bias"] = ((E,), ("embed",))
+        if a.pre_post_norm:
+            specs["post_attn_norm"] = ((E,), ("embed",))
+            specs["post_mlp_norm"] = ((E,), ("embed",))
+        if moe:
+            X = a.num_experts
+            Im = a.moe_intermediate_size or I
+            specs.update({
+                "router": ((E, X), ("embed", "expert")),
+                "experts_gate": ((X, E, Im), ("expert", "embed", "intermediate")),
+                "experts_up": ((X, E, Im), ("expert", "embed", "intermediate")),
+                "experts_down": ((X, Im, E), ("expert", "intermediate", "embed")),
+            })
+            if a.num_shared_experts:
+                Is = Im * a.num_shared_experts
+                specs.update({
+                    "shared_gate": ((E, Is), ("embed", "intermediate")),
+                    "shared_up": ((E, Is), ("embed", "intermediate")),
+                    "shared_down": ((Is, E), ("intermediate", "embed")),
+                })
+        else:
+            if a.gated_mlp:
+                specs["gate"] = ((E, I), ("embed", "intermediate"))
+            specs["up"] = ((E, I), ("embed", "intermediate"))
+            specs["down"] = ((I, E), ("intermediate", "embed"))
+            if a.linear_bias:
+                specs["up_bias"] = ((I,), ("intermediate",))
+                specs["down_bias"] = ((E,), ("embed",))
+        return specs
+
+    def _top_specs(self) -> dict[str, tuple[tuple[int, ...], tuple]]:
+        a = self.arch
+        E = a.hidden_size
+        specs = {
+            "embed": ((self.vocab_padded, E), ("vocab", "embed")),
+            "final_norm": ((E,), ("embed",)),
+        }
+        if a.norm_type == "layernorm":
+            specs["final_norm_bias"] = ((E,), ("embed",))
+        if not a.tie_word_embeddings:
+            specs["lm_head"] = ((self.vocab_padded, E), ("vocab", "embed"))
+        return specs
+
+    def init_params(self, key: jax.Array) -> dict:
+        """Random (synthetic) weights with sane init scales."""
+        params: dict = {}
+        keys = jax.random.split(key, len(self.groups) + 1)
+        for spec_key, (shape, _) in self._top_specs().items():
+            if "norm" in spec_key:
+                params[spec_key] = jnp.zeros(shape, self.dtype) if "bias" in spec_key or self.arch.norm_offset else jnp.ones(shape, self.dtype)
+            else:
+                params[spec_key] = 0.02 * jax.random.normal(
+                    jax.random.fold_in(keys[0], hash(spec_key) % 2**31), shape, self.dtype)
+        for gi, g in enumerate(self.groups):
+            layer: dict = {}
+            for name, (shape, _) in self._layer_specs(g.moe).items():
+                full = (g.count,) + shape
+                if "norm" in name and "bias" not in name:
+                    init = jnp.zeros(full, self.dtype) if self.arch.norm_offset else jnp.ones(full, self.dtype)
+                elif name.endswith("_bias") or "bias" in name:
+                    init = jnp.zeros(full, self.dtype)
+                else:
+                    fan_in = shape[-2] if len(shape) >= 2 else shape[-1]
+                    std = 1.0 / math.sqrt(fan_in)
+                    init = std * jax.random.normal(
+                        jax.random.fold_in(keys[1 + gi], hash(name) % 2**31), full, self.dtype)
+                layer[name] = init
+            params[g.name] = layer
+        return params
+
+    def param_logical_axes(self) -> dict:
+        """Tree matching init_params with logical axis names per dim."""
+        axes: dict = {}
+        for name, (_, ax) in self._top_specs().items():
+            axes[name] = ax
+        for g in self.groups:
+            axes[g.name] = {
+                name: ("layers",) + ax
+                for name, (_, ax) in self._layer_specs(g.moe).items()
+            }
+        return axes
+
+    def param_count(self, params: dict) -> int:
+        return sum(x.size for x in jax.tree.leaves(params))
+
+    # ------------------------------------------------------------------
+    # Flags / rope tables
+    # ------------------------------------------------------------------
+
+    def _make_inv_freq_local(self) -> jax.Array:
+        # gemma-3 sliding layers use unscaled theta=10k rope
+        a = self.arch
+        if a.sliding_window_pattern and a.sliding_window:
+            from dataclasses import replace
+
+            local = replace(a, rope_theta=10000.0, rope_scaling=None)
+            return nn.rope_frequencies(local)
+        return self._inv_freq_global
+
+    def _window_flags(self, start: int, count: int) -> Optional[jax.Array]:
+        """Per-layer int32 window sizes (or _BIG_WINDOW for global)."""
+        a = self.arch
+        if not a.sliding_window:
+            return None
+        idx = jnp.arange(start, start + count)
+        if a.sliding_window_pattern:
+            is_global = (idx + 1) % a.sliding_window_pattern == 0
+        else:
+            is_global = jnp.zeros_like(idx, dtype=bool)
+        return jnp.where(is_global, _BIG_WINDOW, a.sliding_window).astype(jnp.int32)
+
+    @property
+    def _scale(self) -> float:
+        a = self.arch
+        denom = a.query_pre_attn_scalar if a.query_pre_attn_scalar else a.head_dim
+        return 1.0 / math.sqrt(denom)
+
+    # ------------------------------------------------------------------
+    # Layer body (shared by prefill and decode via mode switch)
+    # ------------------------------------------------------------------
+
+    def _attn_qkv(self, x: jax.Array, p: dict, positions: jax.Array,
+                  window: Optional[jax.Array]):
+        """Project to q/k/v heads with norms+rope applied.
+
+        x: [B, T, E]; positions: [B, T] absolute positions.
+        """
+        a = self.arch
+        B, T, _ = x.shape
+        q = x @ p["q"]
+        k = x @ p["k"]
+        v = x @ p["v"]
+        if "q_bias" in p:
+            q, k, v = q + p["q_bias"], k + p["k_bias"], v + p["v_bias"]
+        q = q.reshape(B, T, a.num_heads, a.head_dim)
+        k = k.reshape(B, T, a.num_kv_heads, a.head_dim)
+        v = v.reshape(B, T, a.num_kv_heads, a.head_dim)
+        if a.qk_norm:
+            q = nn.rms_norm(q, p["q_norm"], a.rms_norm_eps, a.norm_offset)
+            k = nn.rms_norm(k, p["k_norm"], a.rms_norm_eps, a.norm_offset)
+        if window is None or self._inv_freq_local is self._inv_freq_global:
+            inv_freq = self._inv_freq_global
+        else:
+            inv_freq = jnp.where(window >= _BIG_WINDOW,
+                                 self._inv_freq_global, self._inv_freq_local)
+        q = nn.apply_rope(q, positions, inv_freq, a.head_dim)
+        k = nn.apply_rope(k, positions, inv_freq, a.head_dim)
+        return q, k, v
+
+    def _mlp(self, x: jax.Array, p: dict, moe: bool) -> jax.Array:
+        if moe:
+            B, T, E = x.shape
+            y = nn.moe_mlp(x.reshape(B * T, E), p, self.arch)
+            return y.reshape(B, T, E)
+        return nn.mlp(x, p, self.arch)
+
+    def _norm(self, x, p, name):
+        if self.arch.norm_type == "layernorm":
+            return nn.layer_norm(x, p[name], p.get(f"{name}_bias"), self.arch.rms_norm_eps)
+        return nn.rms_norm(x, p[name], self.arch.rms_norm_eps, self.arch.norm_offset)
+
+    def _layer(self, x, p, ck, cv, window, moe, mode, *,
+               positions, page_tables, lengths, true_lens, active):
+        """One transformer block. Returns (x, ck, cv)."""
+        a = self.arch
+        B, T, E = x.shape
+        h = self._norm(x, p, "attn_norm")
+        q, k_new, v_new = self._attn_qkv(h, p, positions, window)
+        ps = ck.shape[1]
+
+        if mode == "prefill":
+            start = jnp.zeros((B,), jnp.int32)
+            ck = write_prefill_tokens(ck, k_new, page_tables, start, true_lens, ps)
+            cv = write_prefill_tokens(cv, v_new, page_tables, start, true_lens, ps)
+            out = attn.prefill_attention(
+                q, k_new, v_new, scale=self._scale,
+                sliding_window=window, logit_softcap=a.attn_logit_softcap,
+                true_len=true_lens)
+        else:
+            ck = write_decode_tokens(ck, k_new[:, 0], page_tables,
+                                     positions[:, 0], ps, active)
+            cv = write_decode_tokens(cv, v_new[:, 0], page_tables,
+                                     positions[:, 0], ps, active)
+            out = attn.paged_decode_attention(
+                q[:, 0], ck, cv, page_tables, lengths, scale=self._scale,
+                sliding_window=window, logit_softcap=a.attn_logit_softcap)
+            out = out[:, None]
+        attn_out = out.reshape(B, T, a.num_heads * a.head_dim) @ p["o"]
+        if "o_bias" in p:
+            attn_out = attn_out + p["o_bias"]
+
+        if a.parallel_residual:
+            mlp_out = self._mlp(h, p, moe)
+            return x + attn_out + mlp_out, ck, cv
+
+        if a.pre_post_norm:
+            attn_out = self._norm(attn_out, p, "post_attn_norm")
+        x = x + attn_out
+        h2 = self._norm(x, p, "mlp_norm")
+        mlp_out = self._mlp(h2, p, moe)
+        if a.pre_post_norm:
+            mlp_out = self._norm(mlp_out, p, "post_mlp_norm")
+        return x + mlp_out, ck, cv
+
+    # ------------------------------------------------------------------
+    # Forward passes
+    # ------------------------------------------------------------------
+
+    def _run_layers(self, params, cache: KVCache, x, mode, *,
+                    positions, page_tables, lengths, true_lens, active):
+        new_k, new_v = [], []
+        for g in self.groups:
+            stack = params[g.name]
+            ck_g = cache.k[g.start:g.start + g.count]
+            cv_g = cache.v[g.start:g.start + g.count]
+            flags = self._window_flags(g.start, g.count)
+
+            def body(carry, xs, moe=g.moe):
+                h = carry
+                if flags is None:
+                    p, ck_l, cv_l = xs
+                    window = None
+                else:
+                    p, ck_l, cv_l, window = xs
+                h, ck_l, cv_l = self._layer(
+                    h, p, ck_l, cv_l, window, moe, mode,
+                    positions=positions, page_tables=page_tables,
+                    lengths=lengths, true_lens=true_lens, active=active)
+                return h, (ck_l, cv_l)
+
+            xs = (stack, ck_g, cv_g) if flags is None else (stack, ck_g, cv_g, flags)
+            x, (ck_new, cv_new) = jax.lax.scan(body, x, xs)
+            new_k.append(ck_new)
+            new_v.append(cv_new)
+        cache = KVCache(k=jnp.concatenate(new_k) if len(new_k) > 1 else new_k[0],
+                        v=jnp.concatenate(new_v) if len(new_v) > 1 else new_v[0])
+        return x, cache
+
+    def _embed(self, params, tokens):
+        x = params["embed"][tokens].astype(self.dtype)
+        if self.arch.embedding_multiplier:
+            x = x * jnp.asarray(self.arch.embedding_multiplier, self.dtype)
+        return x
+
+    def _logits(self, params, x):
+        head = params["embed"] if self.arch.tie_word_embeddings else params["lm_head"]
+        logits = x.astype(jnp.float32) @ head.astype(jnp.float32).T
+        logits = nn.softcap(logits, self.arch.final_logit_softcap)
+        return logits[..., : self.arch.vocab_size]
+
+    def prefill(self, params, cache: KVCache, tokens, true_lens, page_tables):
+        """Process fresh prompts.
+
+        tokens: [B, T] padded prompts; true_lens: [B]; page_tables:
+        [B, pages_per_seq] pre-allocated.  Returns (cache, last_logits
+        [B, vocab], last_hidden [B, E]).
+        """
+        B, T = tokens.shape
+        positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+        x = self._embed(params, tokens)
+        x, cache = self._run_layers(
+            params, cache, x, "prefill", positions=positions,
+            page_tables=page_tables, lengths=true_lens, true_lens=true_lens,
+            active=None)
+        x = self._norm(x, params, "final_norm")
+        last = jnp.take_along_axis(
+            x, (true_lens - 1)[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+        return cache, self._logits(params, last), last
+
+    def decode(self, params, cache: KVCache, tokens, positions, page_tables,
+               active=None):
+        """One decode step for a batch of slots.
+
+        tokens: [B] last sampled token; positions: [B] their positions;
+        lengths after write are positions+1.  Returns (cache, logits).
+        """
+        B = tokens.shape[0]
+        pos2 = positions[:, None].astype(jnp.int32)
+        x = self._embed(params, tokens[:, None])
+        x, cache = self._run_layers(
+            params, cache, x, "decode", positions=pos2,
+            page_tables=page_tables, lengths=positions + 1, true_lens=None,
+            active=active)
+        x = self._norm(x, params, "final_norm")
+        return cache, self._logits(params, x[:, 0])

@@ -1,0 +1,168 @@
+"""Neural-net building blocks for the config-driven transformer.
+
+Functional JAX (no module framework): parameters are plain pytrees so
+the engine controls placement/donation precisely and trees map 1:1 onto
+logical sharding axes (kaito_tpu.parallel.sharding).  Compute runs in
+the params' dtype (bf16 on TPU) with fp32 norms/softmax, which is what
+the MXU wants.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from kaito_tpu.models.metadata import ModelArch
+
+
+def rms_norm(x: jax.Array, scale: jax.Array, eps: float, offset: bool) -> jax.Array:
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    y = x32 * jax.lax.rsqrt(var + eps)
+    w = scale.astype(jnp.float32)
+    if offset:
+        w = 1.0 + w
+    return (y * w).astype(dtype)
+
+
+def layer_norm(x: jax.Array, scale: jax.Array, bias: Optional[jax.Array], eps: float) -> jax.Array:
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mean) * jax.lax.rsqrt(var + eps) * scale.astype(jnp.float32)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y.astype(dtype)
+
+
+def apply_norm(x: jax.Array, params: dict, arch: ModelArch) -> jax.Array:
+    if arch.norm_type == "layernorm":
+        return layer_norm(x, params["scale"], params.get("bias"), arch.rms_norm_eps)
+    return rms_norm(x, params["scale"], arch.rms_norm_eps, arch.norm_offset)
+
+
+# ---------------------------------------------------------------------------
+# Rotary position embedding (with llama3 / linear / yarn-style scaling)
+# ---------------------------------------------------------------------------
+
+def rope_frequencies(arch: ModelArch) -> jax.Array:
+    """Per-pair inverse frequencies, with rope_scaling applied."""
+    rot_dim = int(arch.head_dim * arch.partial_rotary_factor)
+    rot_dim -= rot_dim % 2
+    exponent = jnp.arange(0, rot_dim, 2, dtype=jnp.float32) / rot_dim
+    inv_freq = 1.0 / (arch.rope_theta ** exponent)
+
+    scaling = arch.rope_scaling or {}
+    rope_type = str(scaling.get("rope_type", scaling.get("type", ""))).lower()
+    if rope_type == "linear":
+        inv_freq = inv_freq / float(scaling.get("factor", 1.0))
+    elif rope_type == "llama3":
+        # Llama-3.1 frequency-dependent scaling: low-frequency components
+        # are stretched by `factor`, high-frequency kept, mid smoothed.
+        factor = float(scaling.get("factor", 8.0))
+        low = float(scaling.get("low_freq_factor", 1.0))
+        high = float(scaling.get("high_freq_factor", 4.0))
+        old_len = float(scaling.get("original_max_position_embeddings", 8192))
+        wavelen = 2.0 * math.pi / inv_freq
+        low_wl = old_len / low
+        high_wl = old_len / high
+        smooth = (old_len / wavelen - low) / (high - low)
+        smooth = jnp.clip(smooth, 0.0, 1.0)
+        scaled = jnp.where(
+            wavelen > low_wl,
+            inv_freq / factor,
+            jnp.where(wavelen < high_wl, inv_freq,
+                      (1 - smooth) * inv_freq / factor + smooth * inv_freq),
+        )
+        inv_freq = scaled
+    elif rope_type in ("yarn", "longrope"):
+        # Serving-grade approximation: plain NTK-by-parts is replaced by
+        # uniform interpolation at the trained factor; exact yarn ramps
+        # land with the long-context milestone.
+        inv_freq = inv_freq / float(scaling.get("factor", 1.0))
+    return inv_freq
+
+
+def apply_rope(x: jax.Array, positions: jax.Array, inv_freq: jax.Array,
+               head_dim: int) -> jax.Array:
+    """Rotate the first ``2*len(inv_freq)`` dims of each head.
+
+    x: [..., seq, heads, head_dim]; positions: [..., seq].
+    """
+    rot = 2 * inv_freq.shape[0]
+    angles = positions[..., :, None].astype(jnp.float32) * inv_freq  # [..., seq, rot/2]
+    cos = jnp.cos(angles)[..., :, None, :]
+    sin = jnp.sin(angles)[..., :, None, :]
+    x_rot = x[..., :rot].astype(jnp.float32)
+    x_pass = x[..., rot:]
+    x1, x2 = jnp.split(x_rot, 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return jnp.concatenate([out.astype(x.dtype), x_pass], axis=-1)
+
+
+def activation(x: jax.Array, name: str) -> jax.Array:
+    if name == "silu":
+        return jax.nn.silu(x)
+    if name in ("gelu",):
+        return jax.nn.gelu(x, approximate=False)
+    if name in ("gelu_tanh", "gelu_new"):
+        return jax.nn.gelu(x, approximate=True)
+    raise ValueError(f"unknown activation {name!r}")
+
+
+def mlp(x: jax.Array, p: dict, arch: ModelArch) -> jax.Array:
+    """Gated (SwiGLU/GeGLU) or classic 2-matrix MLP."""
+    if arch.gated_mlp:
+        gate = activation(x @ p["gate"], arch.hidden_act)
+        up = x @ p["up"]
+        h = gate * up
+    else:
+        h = x @ p["up"]
+        if "up_bias" in p:
+            h = h + p["up_bias"]
+        h = activation(h, arch.hidden_act)
+    out = h @ p["down"]
+    if "down_bias" in p:
+        out = out + p["down_bias"]
+    return out
+
+
+def moe_mlp(x: jax.Array, p: dict, arch: ModelArch) -> jax.Array:
+    """Token-choice MoE with dense expert compute.
+
+    x: [T, E].  Routing picks top-k experts per token; compute is done
+    as dense einsums over all experts with a routing-weight mask —
+    static shapes, MXU-friendly, exact (at the cost of FLOPs
+    proportional to expert count; a Pallas grouped-matmul replaces this
+    on the perf milestone).
+    """
+    T, E = x.shape
+    X = arch.num_experts
+    k = arch.num_experts_per_tok
+    logits = (x.astype(jnp.float32) @ p["router"].astype(jnp.float32))  # [T, X]
+    weights, idx = jax.lax.top_k(logits, k)                             # [T, k]
+    weights = jax.nn.softmax(weights, axis=-1)
+    # scatter top-k weights back to a dense [T, X] routing matrix
+    route = jnp.zeros((T, X), jnp.float32)
+    route = route.at[jnp.arange(T)[:, None], idx].set(weights)
+    # dense expert compute: h[x] = act(x @ gate_x) * (x @ up_x) @ down_x
+    gate = jnp.einsum("te,xei->txi", x, p["experts_gate"])
+    up = jnp.einsum("te,xei->txi", x, p["experts_up"])
+    h = activation(gate, arch.hidden_act) * up
+    out = jnp.einsum("txi,xie->txe", h, p["experts_down"])
+    y = jnp.einsum("txe,tx->te", out.astype(jnp.float32), route).astype(x.dtype)
+    if "shared_gate" in p:
+        shared = {"gate": p["shared_gate"], "up": p["shared_up"], "down": p["shared_down"]}
+        y = y + mlp(x, shared, arch)
+    return y
+
+
+def softcap(x: jax.Array, cap: Optional[float]) -> jax.Array:
+    if not cap:
+        return x
+    return jnp.tanh(x / cap) * cap

@@ -1,0 +1,81 @@
+"""Token sampling: greedy / temperature / top-k / top-p, fully batched
+and jittable (no data-dependent shapes).
+
+Per-slot sampling parameters live in arrays so one compiled decode step
+serves heterogeneous requests — the continuous-batching analogue of
+vLLM's SamplingParams handling inside the reference's engine.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class SamplingState:
+    """Per-slot sampling knobs, shape [B]."""
+
+    temperature: jax.Array   # 0 => greedy
+    top_k: jax.Array         # 0 => disabled
+    top_p: jax.Array         # 1.0 => disabled
+    key: jax.Array           # [B, 2] per-slot PRNG keys
+
+    @staticmethod
+    def create(batch: int, seed: int = 0) -> "SamplingState":
+        keys = jax.random.split(jax.random.PRNGKey(seed), batch)
+        return SamplingState(
+            temperature=jnp.ones((batch,), jnp.float32),
+            top_k=jnp.zeros((batch,), jnp.int32),
+            top_p=jnp.ones((batch,), jnp.float32),
+            key=jnp.asarray(keys, jnp.uint32),
+        )
+
+    def set_slot(self, i: int, *, temperature: float, top_k: int, top_p: float,
+                 seed: int) -> "SamplingState":
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), i)
+        return SamplingState(
+            temperature=self.temperature.at[i].set(temperature),
+            top_k=self.top_k.at[i].set(top_k),
+            top_p=self.top_p.at[i].set(top_p),
+            key=self.key.at[i].set(jnp.asarray(key, jnp.uint32)),
+        )
+
+
+def sample(logits: jax.Array, state: SamplingState) -> tuple[jax.Array, SamplingState]:
+    """Sample one token per row. logits: [B, V] fp32."""
+    B, V = logits.shape
+    temp = jnp.maximum(state.temperature, 1e-6)[:, None]
+    scaled = logits / temp
+
+    # top-k: mask logits below the k-th largest (k==0 disables)
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+    k = jnp.clip(state.top_k, 0, V)
+    kth = jnp.take_along_axis(
+        sorted_desc, jnp.maximum(k - 1, 0)[:, None], axis=-1)
+    scaled = jnp.where((k[:, None] > 0) & (scaled < kth), -jnp.inf, scaled)
+
+    # top-p (nucleus): keep the smallest prefix of the sorted distribution
+    # with cumulative prob >= p
+    probs_sorted = jax.nn.softmax(sorted_desc, axis=-1)
+    cum = jnp.cumsum(probs_sorted, axis=-1)
+    cutoff_idx = jnp.sum(cum < state.top_p[:, None], axis=-1)  # [B]
+    cutoff_val = jnp.take_along_axis(sorted_desc, cutoff_idx[:, None], axis=-1)
+    scaled = jnp.where(scaled < cutoff_val, -jnp.inf, scaled)
+
+    def one(key_data, row):
+        key = jax.random.wrap_key_data(key_data, impl="threefry2x32")
+        new_key, sub = jax.random.split(key)
+        tok = jax.random.categorical(sub, row)
+        return jax.random.key_data(new_key), tok
+
+    new_keys, sampled = jax.vmap(one)(state.key, scaled)
+    greedy = jnp.argmax(logits, axis=-1)
+    tokens = jnp.where(state.temperature <= 0.0, greedy, sampled)
+    new_state = SamplingState(
+        temperature=state.temperature, top_k=state.top_k, top_p=state.top_p,
+        key=new_keys)
+    return tokens.astype(jnp.int32), new_state

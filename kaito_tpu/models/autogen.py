@@ -102,9 +102,12 @@ def arch_from_hf_config(cfg: Mapping) -> ModelArch:
             kw["final_logit_softcap"] = _first(cfg, "final_logit_softcapping", default=30.0)
         if model_type in ("gemma3", "gemma3_text"):
             kw["sliding_window_pattern"] = int(_first(cfg, "sliding_window_pattern", default=6))
+            kw["qk_norm"] = True
 
     if model_type == "qwen2":
         kw["qkv_bias"] = True
+    if model_type == "qwen3":
+        kw["qk_norm"] = True
 
     if model_type in ("mixtral",):
         kw.update(
@@ -117,6 +120,8 @@ def arch_from_hf_config(cfg: Mapping) -> ModelArch:
             num_experts=int(_first(cfg, "num_local_experts", "num_experts", default=32)),
             num_experts_per_tok=int(_first(cfg, "num_experts_per_tok", "experts_per_token", default=4)),
             moe_intermediate_size=int(_first(cfg, "intermediate_size", default=2880)),
+            # gpt-oss alternates sliding/full attention layer types
+            sliding_window_pattern=2,
         )
 
     if model_type in ("deepseek_v2", "deepseek_v3"):
@@ -133,8 +138,15 @@ def arch_from_hf_config(cfg: Mapping) -> ModelArch:
             v_head_dim=_first(cfg, "v_head_dim"),
         )
 
-    if model_type == "falcon" and bool(cfg.get("multi_query", False)) and "num_key_value_heads" not in cfg:
-        kw["num_kv_heads"] = 1
+    if model_type == "falcon":
+        if bool(cfg.get("multi_query", False)) and "num_key_value_heads" not in cfg:
+            kw["num_kv_heads"] = 1
+        kw.update(gated_mlp=False, parallel_residual=bool(cfg.get("parallel_attn", True)),
+                  norm_type="layernorm")
+
+    if model_type == "phi":
+        kw.update(gated_mlp=False, parallel_residual=True, norm_type="layernorm",
+                  linear_bias=True)
 
     return ModelArch(**kw)
 

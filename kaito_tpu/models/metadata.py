@@ -48,9 +48,13 @@ class ModelArch:
 
     # nonlinearity / norms
     hidden_act: str = "silu"          # silu (swiglu) | gelu | gelu_tanh (geglu)
+    gated_mlp: bool = True            # False: classic 2-matrix MLP (falcon, phi-2)
     rms_norm_eps: float = 1e-5
+    norm_type: str = "rmsnorm"        # rmsnorm | layernorm
     norm_offset: bool = False         # gemma: weight = 1 + w
     pre_post_norm: bool = False       # gemma-2/3: extra post-attn/post-mlp norms
+    parallel_residual: bool = False   # falcon/phi-2: x + attn(n(x)) + mlp(n(x))
+    linear_bias: bool = False         # phi-2: biases on all projections
 
     # rotary embedding
     rope_theta: float = 10000.0
@@ -58,6 +62,7 @@ class ModelArch:
     rope_scaling: Optional[dict] = None   # {"rope_type": "llama3"|"linear"|"yarn", ...}
 
     # attention details
+    qk_norm: bool = False             # gemma-3 / qwen-3: RMSNorm on q and k heads
     qkv_bias: bool = False            # qwen2
     attn_logit_softcap: Optional[float] = None   # gemma-2
     final_logit_softcap: Optional[float] = None  # gemma-2
