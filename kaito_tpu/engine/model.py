@@ -308,14 +308,29 @@ class TransformerLM:
     # Forward passes
     # ------------------------------------------------------------------
 
-    def _run_layers(self, params, cache: KVCache, x, mode, *,
-                    positions, page_tables, lengths, true_lens, active):
+    def _run_layers(self, params, cache: Optional[KVCache], x, mode, *,
+                    positions, page_tables, lengths, true_lens, active,
+                    remat: bool = False):
         new_k, new_v = [], []
         for g in self.groups:
             stack = params[g.name]
+            flags = self._window_flags(g.start, g.count)
+            if mode == "train":
+                def body(carry, xs, moe=g.moe):
+                    h = carry
+                    (p, window) = xs if flags is not None else (xs[0], None)
+                    h = self._layer_train(h, p, window, moe, positions=positions,
+                                          true_lens=true_lens)
+                    return h, None
+
+                if remat:
+                    body = jax.checkpoint(body, prevent_cse=False)
+                xs = (stack,) if flags is None else (stack, flags)
+                x, _ = jax.lax.scan(body, x, xs)
+                continue
+
             ck_g = cache.k[g.start:g.start + g.count]
             cv_g = cache.v[g.start:g.start + g.count]
-            flags = self._window_flags(g.start, g.count)
 
             def body(carry, xs, moe=g.moe):
                 h = carry
@@ -334,9 +349,34 @@ class TransformerLM:
             x, (ck_new, cv_new) = jax.lax.scan(body, x, xs)
             new_k.append(ck_new)
             new_v.append(cv_new)
+        if mode == "train":
+            return x, None
         cache = KVCache(k=jnp.concatenate(new_k) if len(new_k) > 1 else new_k[0],
                         v=jnp.concatenate(new_v) if len(new_v) > 1 else new_v[0])
         return x, cache
+
+    def _layer_train(self, x, p, window, moe, *, positions, true_lens):
+        """Transformer block without KV-cache plumbing (training)."""
+        a = self.arch
+        B, T, E = x.shape
+        h = self._norm(x, p, "attn_norm")
+        q, k_new, v_new = self._attn_qkv(h, p, positions, window)
+        out = attn.prefill_attention(
+            q, k_new, v_new, scale=self._scale, sliding_window=window,
+            logit_softcap=a.attn_logit_softcap, true_len=true_lens)
+        attn_out = out.reshape(B, T, a.num_heads * a.head_dim) @ p["o"]
+        if "o_bias" in p:
+            attn_out = attn_out + p["o_bias"]
+        if a.parallel_residual:
+            return x + attn_out + self._mlp(h, p, moe)
+        if a.pre_post_norm:
+            attn_out = self._norm(attn_out, p, "post_attn_norm")
+        x = x + attn_out
+        h2 = self._norm(x, p, "mlp_norm")
+        mlp_out = self._mlp(h2, p, moe)
+        if a.pre_post_norm:
+            mlp_out = self._norm(mlp_out, p, "post_mlp_norm")
+        return x + mlp_out
 
     def _embed(self, params, tokens):
         x = params["embed"][tokens].astype(self.dtype)
@@ -385,3 +425,21 @@ class TransformerLM:
             active=active)
         x = self._norm(x, params, "final_norm")
         return cache, self._logits(params, x[:, 0])
+
+    def forward_train(self, params, tokens, mask=None, remat: bool = True):
+        """Full-sequence forward for training: [B, T] -> logits [B, T, V].
+
+        Rematerializes each layer (jax.checkpoint) so activation memory
+        stays O(sqrt) — the TPU trade the reference never makes because
+        HF Trainer owns its training loop.
+        """
+        B, T = tokens.shape
+        positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+        true_lens = mask.sum(-1).astype(jnp.int32) if mask is not None else \
+            jnp.full((B,), T, jnp.int32)
+        x = self._embed(params, tokens)
+        x, _ = self._run_layers(
+            params, None, x, "train", positions=positions, page_tables=None,
+            lengths=None, true_lens=true_lens, active=None, remat=remat)
+        x = self._norm(x, params, "final_norm")
+        return self._logits(params, x)

@@ -1,0 +1,1 @@
+from kaito_tpu.tuning.train_step import TrainState, make_train_step, shard_train_state  # noqa: F401

@@ -1,0 +1,113 @@
+"""Sharded training step.
+
+The compute core of the tuning path (the reference delegates this to
+``accelerate launch ... fine_tuning.py`` + HF Trainer,
+``presets/workspace/tuning/text-generation/fine_tuning.py``): a jitted
+forward/backward/update over the planner's mesh — dp/fsdp for batch,
+tensor for megatron-style weight sharding, sequence for long-context
+ring attention, expert for MoE — with per-layer rematerialization.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from kaito_tpu.engine.model import TransformerLM
+from kaito_tpu.parallel.sharding import TRAIN_RULES, PartitionRules
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class TrainState:
+    params: dict
+    opt_state: object
+    step: jax.Array
+
+
+def cross_entropy_loss(logits: jax.Array, targets: jax.Array,
+                       mask: jax.Array) -> jax.Array:
+    """Masked next-token CE. logits [B,T,V] fp32; targets/mask [B,T]."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    mask = mask.astype(jnp.float32)
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def make_train_step(model: TransformerLM, optimizer: optax.GradientTransformation):
+    """Build the jittable (state, batch) -> (state, metrics) step.
+
+    batch: {"tokens": [B, T+1] int32, "mask": [B, T] float}; predicts
+    tokens[:, 1:] from tokens[:, :-1].
+    """
+
+    def loss_fn(params, batch):
+        inputs = batch["tokens"][:, :-1]
+        targets = batch["tokens"][:, 1:]
+        logits = model.forward_train(params, inputs)
+        return cross_entropy_loss(logits, targets, batch["mask"])
+
+    def train_step(state: TrainState, batch) -> tuple[TrainState, dict]:
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+        updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(params=params, opt_state=opt_state,
+                               step=state.step + 1)
+        grad_norm = optax.global_norm(grads)
+        return new_state, {"loss": loss, "grad_norm": grad_norm}
+
+    return train_step
+
+
+def param_shardings(model: TransformerLM, mesh: Mesh,
+                    rules: PartitionRules = TRAIN_RULES):
+    """NamedShardings for every param from its logical axes."""
+    axes = model.param_logical_axes()
+    return jax.tree.map(
+        lambda ax: NamedSharding(mesh, rules.spec(ax)),
+        axes, is_leaf=lambda x: isinstance(x, tuple))
+
+
+def shard_train_state(model: TransformerLM, state: TrainState, mesh: Mesh,
+                      rules: PartitionRules = TRAIN_RULES) -> TrainState:
+    """Place params + optimizer state on the mesh (optimizer moments
+    share the param sharding; scalars replicate)."""
+    p_sh = param_shardings(model, mesh, rules)
+
+    def place(x, sh):
+        return jax.device_put(x, sh)
+
+    params = jax.tree.map(place, state.params, p_sh)
+    opt_state = _shard_opt_state(state.opt_state, state.params, p_sh, mesh)
+    return TrainState(params=params, opt_state=opt_state,
+                      step=jax.device_put(state.step, NamedSharding(mesh, P())))
+
+
+def _shard_opt_state(opt_state, params, p_sh, mesh):
+    """Shard optimizer-state leaves that mirror the param tree."""
+    p_leaves = jax.tree.leaves(params)
+    sh_leaves = jax.tree.leaves(p_sh, is_leaf=lambda x: isinstance(x, NamedSharding))
+    shape_to_sh = {}
+    for leaf, sh in zip(p_leaves, sh_leaves):
+        shape_to_sh.setdefault(leaf.shape, sh)
+
+    def place(x):
+        if hasattr(x, "shape") and x.shape in shape_to_sh and x.ndim > 0:
+            return jax.device_put(x, shape_to_sh[x.shape])
+        return jax.device_put(x, NamedSharding(mesh, P()))
+
+    return jax.tree.map(place, opt_state)
+
+
+def data_sharding(mesh: Mesh, rules: PartitionRules = TRAIN_RULES):
+    return {
+        "tokens": NamedSharding(mesh, rules.spec(("batch", None))),
+        "mask": NamedSharding(mesh, rules.spec(("batch", None))),
+    }
