@@ -29,6 +29,7 @@ def main():
     ap.add_argument("--prompt-len", type=int, default=128)
     ap.add_argument("--decode-steps", type=int, default=128)
     ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--attn-impl", default="", choices=["", "jax", "pallas"])
     args = ap.parse_args()
 
     import jax
@@ -48,7 +49,9 @@ def main():
     log(f"bench: {model_name} on {jax.devices()[0]} batch={batch} "
         f"prompt={args.prompt_len} steps={args.decode_steps}")
 
-    model = TransformerLM(arch, dtype=dtype)
+    attn_impl = args.attn_impl or "jax"  # pallas default flips once TPU-validated
+    model = TransformerLM(arch, dtype=dtype, attn_impl=attn_impl)
+    log(f"attention impl: {attn_impl}")
     t0 = time.monotonic()
     params = jax.jit(model.init_params)(jax.random.PRNGKey(0))
     jax.block_until_ready(params)

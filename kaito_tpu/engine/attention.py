@@ -64,7 +64,7 @@ def prefill_attention(
 
 def paged_decode_attention(
     q: jax.Array,            # [B, H, D] (one new token per sequence)
-    cache_k: jax.Array,      # [num_pages, page_size, Hkv, D]
+    cache_k: jax.Array,      # [num_pages, Hkv, page_size, D]
     cache_v: jax.Array,
     page_tables: jax.Array,  # [B, pages_per_seq]
     lengths: jax.Array,      # [B] tokens in cache INCLUDING the new one
@@ -73,18 +73,19 @@ def paged_decode_attention(
     sliding_window: Optional[int] = None,
     logit_softcap: Optional[float] = None,
 ) -> jax.Array:
-    """Attend one query token per sequence over its paged KV history."""
+    """Attend one query token per sequence over its paged KV history
+    (pure-JAX reference; the Pallas kernel in engine.ops implements the
+    same contract)."""
     B, H, D = q.shape
-    ps = cache_k.shape[1]
-    Hkv = cache_k.shape[2]
+    _, Hkv, ps, _ = cache_k.shape
     pmax = page_tables.shape[1]
     S = pmax * ps
     groups = H // Hkv
 
-    k = cache_k[page_tables]                      # [B, pmax, ps, Hkv, D]
+    k = cache_k[page_tables]                      # [B, pmax, Hkv, ps, D]
     v = cache_v[page_tables]
-    k = k.reshape(B, S, Hkv, D)
-    v = v.reshape(B, S, Hkv, D)
+    k = jnp.moveaxis(k, 2, 3).reshape(B, S, Hkv, D)
+    v = jnp.moveaxis(v, 2, 3).reshape(B, S, Hkv, D)
 
     qg = q.reshape(B, Hkv, groups, D)
     scores = jnp.einsum("bkgd,bskd->bkgs", qg, k, preferred_element_type=jnp.float32)

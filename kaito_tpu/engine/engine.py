@@ -124,7 +124,10 @@ class InferenceEngine:
         self.md = metadata or get_model_by_name(cfg.model)
         arch = self.md.arch
         self.dtype = jnp.dtype(cfg.dtype)
-        self.model = TransformerLM(arch, dtype=self.dtype)
+        use_pallas = bool(cfg.use_pallas)  # default off until TPU-validated
+        self.model = TransformerLM(
+            arch, dtype=self.dtype,
+            attn_impl="pallas" if use_pallas else "jax")
         self.tokenizer = load_tokenizer(self.md.hf_id, arch.vocab_size)
         self.mesh = mesh
 

@@ -1,11 +1,15 @@
 """Paged KV cache.
 
 The engine's KV memory is a global page pool per layer —
-``[num_layers, num_pages, page_size, kv_heads, head_dim]`` — addressed
+``[num_layers, num_pages, kv_heads, page_size, head_dim]`` — addressed
 through per-sequence page tables, vLLM-style but with static shapes
 throughout so XLA compiles one program per (bucket, batch) shape.  The
 reference delegates this entirely to vLLM's PagedAttention
 (SURVEY.md §2.3); on TPU we own it.
+
+The page-major layout makes each page one contiguous
+``[kv_heads, page_size, head_dim]`` block in HBM — a single clean
+leading-index DMA per page in the Pallas decode kernel.
 
 Page 0 is reserved as the null page: unused page-table slots point at
 it, so gathers are always in-bounds and masking is done by length, not
@@ -30,7 +34,7 @@ NULL_PAGE = 0
 class KVCache:
     """Stacked per-layer page pools (a pytree; donate on every step)."""
 
-    k: jax.Array  # [L, num_pages, page_size, kv_heads, head_dim]
+    k: jax.Array  # [L, num_pages, kv_heads, page_size, head_dim]
     v: jax.Array
 
     @property
@@ -39,7 +43,7 @@ class KVCache:
 
     @property
     def page_size(self) -> int:
-        return self.k.shape[2]
+        return self.k.shape[3]
 
 
 def create_kv_cache(
@@ -48,12 +52,12 @@ def create_kv_cache(
     page_size: int,
     dtype: jnp.dtype = jnp.bfloat16,
 ) -> KVCache:
-    shape = (arch.num_layers, num_pages, page_size, arch.num_kv_heads, arch.head_dim)
+    shape = (arch.num_layers, num_pages, arch.num_kv_heads, page_size, arch.head_dim)
     return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
 
 
 def write_prefill_tokens(
-    cache_layer: jax.Array,       # [num_pages, page_size, Hkv, D]
+    cache_layer: jax.Array,       # [num_pages, Hkv, page_size, D]
     new: jax.Array,               # [B, T, Hkv, D]
     page_tables: jax.Array,       # [B, pages_per_seq] int32
     start_pos: jax.Array,         # [B] sequence position of new[:, 0]
@@ -69,22 +73,21 @@ def write_prefill_tokens(
     valid = t < true_lens[:, None]
     page_idx = jnp.where(valid, page_idx, NULL_PAGE)
     offset = pos % page_size
-    flat = new.reshape(B * T, *new.shape[2:])
-    return cache_layer.at[page_idx.reshape(-1), offset.reshape(-1)].set(flat)
+    flat = new.reshape(B * T, *new.shape[2:])                      # [B*T, Hkv, D]
+    return cache_layer.at[page_idx.reshape(-1), :, offset.reshape(-1)].set(flat)
 
 
 def write_decode_tokens(
-    cache_layer: jax.Array,       # [num_pages, page_size, Hkv, D]
+    cache_layer: jax.Array,       # [num_pages, Hkv, page_size, D]
     new: jax.Array,               # [B, Hkv, D] one token per sequence
     page_tables: jax.Array,       # [B, pages_per_seq]
     positions: jax.Array,         # [B] current position of each new token
     page_size: int,
     active: Optional[jax.Array] = None,  # [B] bool; inactive rows hit page 0
 ) -> jax.Array:
-    B = new.shape[0]
     page_idx = jnp.take_along_axis(
         page_tables, (positions // page_size)[:, None], axis=1)[:, 0]
     if active is not None:
         page_idx = jnp.where(active, page_idx, NULL_PAGE)
     offset = positions % page_size
-    return cache_layer.at[page_idx, offset].set(new)
+    return cache_layer.at[page_idx, :, offset].set(new)

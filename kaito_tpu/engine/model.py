@@ -55,13 +55,15 @@ def _layer_groups(arch: ModelArch) -> tuple[LayerGroup, ...]:
 class TransformerLM:
     """Functional model: all state lives in explicit params/cache trees."""
 
-    def __init__(self, arch: ModelArch, dtype=jnp.bfloat16):
+    def __init__(self, arch: ModelArch, dtype=jnp.bfloat16,
+                 attn_impl: str = "jax"):
         if arch.attention_kind == AttentionKind.MLA:
             raise NotImplementedError(
                 "MLA attention (DeepSeek V2/V3) lands with a dedicated kernel; "
                 "distilled llama/qwen checkpoints serve today")
         self.arch = arch
         self.dtype = dtype
+        self.attn_impl = attn_impl  # "jax" | "pallas" (paged decode)
         self.groups = _layer_groups(arch)
         self.vocab_padded = -(-arch.vocab_size // VOCAB_ALIGN) * VOCAB_ALIGN
         # rope tables are concrete constants; computing them lazily inside
@@ -268,7 +270,7 @@ class TransformerLM:
         B, T, E = x.shape
         h = self._norm(x, p, "attn_norm")
         q, k_new, v_new = self._attn_qkv(h, p, positions, window)
-        ps = ck.shape[1]
+        ps = ck.shape[-2]
 
         if mode == "prefill":
             start = jnp.zeros((B,), jnp.int32)
@@ -283,9 +285,19 @@ class TransformerLM:
                                      positions[:, 0], ps, active)
             cv = write_decode_tokens(cv, v_new[:, 0], page_tables,
                                      positions[:, 0], ps, active)
-            out = attn.paged_decode_attention(
-                q[:, 0], ck, cv, page_tables, lengths, scale=self._scale,
-                sliding_window=window, logit_softcap=a.attn_logit_softcap)
+            if self.attn_impl == "pallas":
+                from kaito_tpu.engine.ops.decode_attention import (
+                    paged_decode_attention_pallas)
+
+                win = window if window is not None else jnp.int32(_BIG_WINDOW)
+                out = paged_decode_attention_pallas(
+                    q[:, 0], ck, cv, page_tables, lengths,
+                    jnp.asarray(win, jnp.int32), scale=self._scale,
+                    softcap=a.attn_logit_softcap)
+            else:
+                out = attn.paged_decode_attention(
+                    q[:, 0], ck, cv, page_tables, lengths, scale=self._scale,
+                    sliding_window=window, logit_softcap=a.attn_logit_softcap)
             out = out[:, None]
         attn_out = out.reshape(B, T, a.num_heads * a.head_dim) @ p["o"]
         if "o_bias" in p:

@@ -1,0 +1,158 @@
+"""Pallas TPU kernel: paged decode attention.
+
+One grid program per sequence.  Each loop iteration DMAs one page of K
+and V for *all* KV heads (the page-major cache layout makes a page one
+contiguous ``[Hkv, page_size, D]`` block) into a 4-deep VMEM ring while the previous page's flash-attention block
+(online softmax, batched over KV heads on the MXU) computes.  HBM
+traffic is exactly one read of the live KV — the decode roofline.
+
+Supports GQA (grouped queries), sliding windows (traced per-layer
+window sizes from the model's scan flags), and gemma-2 logit softcap.
+The pure-JAX fallback in kaito_tpu.engine.attention implements the same
+contract; tests compare the two in interpreter mode.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+N_BUF = 4
+
+
+def _decode_kernel(
+    # scalar prefetch
+    page_tables_ref,   # [B, pmax] SMEM
+    lengths_ref,       # [B] SMEM
+    window_ref,        # [1] SMEM
+    # inputs
+    q_ref,             # [1, Hkv, G, D] VMEM (pre-scaled)
+    k_hbm,             # [P, Hkv, ps, D] ANY/HBM
+    v_hbm,
+    # outputs
+    o_ref,             # [1, Hkv, G, D] VMEM
+    # scratch
+    k_buf,             # [N_BUF, Hkv, ps, D] VMEM
+    v_buf,
+    sems,              # [N_BUF, 2] DMA semaphores
+    *,
+    page_size: int,
+    softcap: Optional[float],
+):
+    b = pl.program_id(0)
+    length = lengths_ref[b]
+    window = window_ref[0]
+    n_pages = pl.cdiv(length, page_size)
+
+    def k_dma(slot, p):
+        return pltpu.make_async_copy(
+            k_hbm.at[page_tables_ref[b, p]], k_buf.at[slot], sems.at[slot, 0])
+
+    def v_dma(slot, p):
+        return pltpu.make_async_copy(
+            v_hbm.at[page_tables_ref[b, p]], v_buf.at[slot], sems.at[slot, 1])
+
+    for i in range(N_BUF):
+        @pl.when(i < n_pages)
+        def _(i=i):
+            k_dma(i, i).start()
+            v_dma(i, i).start()
+
+    q = q_ref[0]                      # [Hkv, G, D]
+    Hkv, G, D = q.shape
+
+    def body(p, carry):
+        m, l, acc = carry
+        slot = jax.lax.rem(p, N_BUF)
+
+        k_dma(slot, p).wait()
+        v_dma(slot, p).wait()
+        k = k_buf[slot]               # [Hkv, ps, D]
+        v = v_buf[slot]
+
+        # scores: batched over kv heads on the MXU
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)          # [Hkv, G, ps]
+        if softcap:
+            s = jnp.tanh(s / softcap) * softcap
+        pos = p * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, 1, page_size), 2)
+        valid = (pos < length) & (pos >= length - window)
+        s = jnp.where(valid, s, NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=2, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p_ij = jnp.exp(s - m_new)
+        l_new = l * alpha + jnp.sum(p_ij, axis=2, keepdims=True)
+        pv = jax.lax.dot_general(
+            p_ij.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)          # [Hkv, G, D]
+
+        # refill the slot we just consumed
+        @pl.when(p + N_BUF < n_pages)
+        def _():
+            k_dma(slot, p + N_BUF).start()
+            v_dma(slot, p + N_BUF).start()
+        return m_new, l_new, acc * alpha + pv
+
+    m0 = jnp.full((Hkv, G, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((Hkv, G, 1), jnp.float32)
+    acc0 = jnp.zeros((Hkv, G, D), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n_pages, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "softcap", "interpret"))
+def paged_decode_attention_pallas(
+    q: jax.Array,            # [B, H, D]
+    cache_k: jax.Array,      # [P, Hkv, ps, D]
+    cache_v: jax.Array,
+    page_tables: jax.Array,  # [B, pmax] int32
+    lengths: jax.Array,      # [B] int32
+    window: jax.Array,       # [] int32 (huge == global attention)
+    *,
+    scale: float,
+    softcap: Optional[float] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    B, H, D = q.shape
+    P, Hkv, ps, _ = cache_k.shape
+    G = H // Hkv
+    q_grouped = (q * scale).reshape(B, Hkv, G, D)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, Hkv, G, D), lambda b, *_: (b, 0, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, Hkv, G, D), lambda b, *_: (b, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((N_BUF, Hkv, ps, D), cache_k.dtype),
+            pltpu.VMEM((N_BUF, Hkv, ps, D), cache_v.dtype),
+            pltpu.SemaphoreType.DMA((N_BUF, 2)),
+        ],
+    )
+
+    kernel = functools.partial(_decode_kernel, page_size=ps, softcap=softcap)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, G, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(page_tables, lengths, jnp.reshape(window, (1,)),
+      q_grouped, cache_k, cache_v)
+    return out.reshape(B, H, D)
