@@ -1,0 +1,28 @@
+"""Chat-message formatting.
+
+The reference ships per-model jinja chat templates
+(``presets/workspace/inference/chat_templates/*.jinja``, 14 files) fed
+to vLLM's ``--chat-template``.  We use the HF tokenizer's own template
+when one is locally available and fall back to a generic ChatML-style
+rendering otherwise (serving synthetic checkpoints, tests).
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Sequence
+
+
+def render_chat(tokenizer, messages: Sequence[Mapping[str, str]]) -> str:
+    apply = getattr(tokenizer, "apply_chat_template", None)
+    if apply is not None:
+        try:
+            return apply(list(messages), tokenize=False, add_generation_prompt=True)
+        except Exception:
+            pass
+    parts = []
+    for m in messages:
+        role = m.get("role", "user")
+        content = m.get("content", "")
+        parts.append(f"<|{role}|>\n{content}\n")
+    parts.append("<|assistant|>\n")
+    return "".join(parts)

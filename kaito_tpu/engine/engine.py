@@ -92,6 +92,8 @@ class PageAllocator:
         return len(self.free)
 
     def alloc(self, n: int) -> list[int]:
+        if n <= 0:
+            return []
         if n > len(self.free):
             raise MemoryError(f"need {n} pages, have {len(self.free)}")
         taken = self.free[-n:][::-1]
@@ -266,6 +268,8 @@ class InferenceEngine:
             raise ValueError(
                 f"prompt length {len(prompt_tokens)} exceeds max_model_len "
                 f"{self.cfg.max_model_len}")
+        if params.max_tokens < 1:
+            raise ValueError(f"max_tokens must be >= 1, got {params.max_tokens}")
         req = Request(req_id or f"req-{self.counters['requests_total']}",
                       list(prompt_tokens), params)
         with self._lock:
@@ -311,11 +315,15 @@ class InferenceEngine:
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
 
+    def _fail_request(self, req: Request):
+        req.finish_reason = "error"
+        req.finish_time = time.monotonic()
+        req.out.put(None)
+
     def _fail_all(self):
         for i, slot in enumerate(self.slots):
             if slot.request is not None:
-                slot.request.finish_reason = "error"
-                slot.request.out.put(None)
+                self._fail_request(slot.request)
                 self.allocator.release(slot.pages)
                 slot.request, slot.pages = None, []
                 self.active[i] = False
@@ -326,8 +334,23 @@ class InferenceEngine:
                 break
             with self._lock:
                 self._waiting_count -= 1
-            req.finish_reason = "error"
-            req.out.put(None)
+            self._fail_request(req)
+        self._recover_cache_if_poisoned()
+
+    def _recover_cache_if_poisoned(self):
+        """A jitted step that raises AFTER buffer donation leaves
+        ``self.cache`` pointing at deleted device memory; every later
+        step would fail.  Rebuild a zeroed pool (in-flight requests were
+        already failed, so the KV content is unreferenced)."""
+        try:
+            poisoned = self.cache.k.is_deleted()
+        except Exception:
+            poisoned = True
+        if poisoned:
+            logger.warning("KV cache was donated into a failed step; rebuilding")
+            self.cache = create_kv_cache(
+                self.md.arch, self.allocator.num_pages, self.cfg.page_size,
+                jnp.dtype(self.cfg.kv_dtype))
 
     def step(self) -> bool:
         """One scheduler iteration. Returns False when idle."""
@@ -351,7 +374,17 @@ class InferenceEngine:
         if req.aborted:
             req.out.put(None)
             return True
+        try:
+            return self._admit(req, free_slot)
+        except Exception:
+            # fail THIS request; the loop (and other requests) live on
+            # unless the cache was donated into the failed step
+            logger.exception("admission failed for %s", req.req_id)
+            self._fail_request(req)
+            self._recover_cache_if_poisoned()
+            return True
 
+    def _admit(self, req: Request, free_slot: int) -> bool:
         n = len(req.prompt_tokens)
         max_total = min(n + req.params.max_tokens, self.cfg.max_model_len)
         pages_needed = -(-max_total // self.cfg.page_size)
@@ -363,11 +396,20 @@ class InferenceEngine:
             return False
 
         pages = self.allocator.alloc(pages_needed)
+        try:
+            return self._admit_with_pages(req, free_slot, pages)
+        except Exception:
+            self.allocator.release(pages)
+            raise
+
+    def _admit_with_pages(self, req: Request, free_slot: int,
+                          pages: list[int]) -> bool:
+        n = len(req.prompt_tokens)
         bucket = self._bucket(n)
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, :n] = req.prompt_tokens
         table = np.zeros((self.pages_per_seq,), np.int32)
-        table[:pages_needed] = pages
+        table[:len(pages)] = pages
 
         fn = self._prefill_fn(bucket)
         self.cache, logits = fn(self.params, self.cache,

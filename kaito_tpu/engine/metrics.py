@@ -1,0 +1,182 @@
+"""Prometheus text-exposition metrics, dependency-free.
+
+The serving metric surface the rest of the system consumes: the
+controller's benchmark probe, the KEDA scaler and the InferencePool EPP
+all scrape :5000/metrics, the way they scrape vLLM's gauges in the
+reference (SURVEY.md §5 "Metrics/logging"; names kept close to vLLM's
+``vllm:*`` series so dashboards translate mechanically to ``kaito:*``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Iterable, Mapping, Optional
+
+
+class Counter:
+    def __init__(self, name: str, help_: str, registry: "Registry",
+                 labels: tuple[str, ...] = ()):
+        self.name, self.help = name, help_
+        self.label_names = labels
+        self._values: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+        registry.register(self)
+
+    def inc(self, amount: float = 1.0, **labels):
+        key = tuple(labels.get(l, "") for l in self.label_names)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def collect(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} counter"
+        if not self._values:
+            yield f"{self.name} 0"
+            return
+        for key, v in sorted(self._values.items()):
+            yield f"{self.name}{_fmt_labels(self.label_names, key)} {_fmt(v)}"
+
+
+class Gauge:
+    def __init__(self, name: str, help_: str, registry: "Registry",
+                 fn=None):
+        self.name, self.help = name, help_
+        self.fn = fn
+        self.value = 0.0
+        registry.register(self)
+
+    def set(self, v: float):
+        self.value = float(v)
+
+    def collect(self) -> Iterable[str]:
+        v = self.fn() if self.fn is not None else self.value
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} gauge"
+        yield f"{self.name} {_fmt(v)}"
+
+
+class Histogram:
+    DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                       0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+    def __init__(self, name: str, help_: str, registry: "Registry",
+                 buckets: Optional[tuple] = None):
+        self.name, self.help = name, help_
+        self.buckets = tuple(buckets or self.DEFAULT_BUCKETS)
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._total = 0
+        self._lock = threading.Lock()
+        registry.register(self)
+
+    def observe(self, v: float):
+        with self._lock:
+            self._sum += v
+            self._total += 1
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    def percentile(self, q: float) -> float:
+        """Approximate quantile from bucket counts (upper bound)."""
+        with self._lock:
+            if not self._total:
+                return 0.0
+            target = q * self._total
+            cum = 0
+            for i, b in enumerate(self.buckets):
+                cum += self._counts[i]
+                if cum >= target:
+                    return b
+            return float("inf")
+
+    def collect(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} histogram"
+        cum = 0
+        for i, b in enumerate(self.buckets):
+            cum += self._counts[i]
+            yield f'{self.name}_bucket{{le="{_fmt(b)}"}} {cum}'
+        cum += self._counts[-1]
+        yield f'{self.name}_bucket{{le="+Inf"}} {cum}'
+        yield f"{self.name}_sum {_fmt(self._sum)}"
+        yield f"{self.name}_count {self._total}"
+
+
+def _fmt(v: float) -> str:
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def _fmt_labels(names, values) -> str:
+    if not names:
+        return ""
+    inner = ",".join(f'{n}="{v}"' for n, v in zip(names, values))
+    return "{" + inner + "}"
+
+
+class Registry:
+    def __init__(self):
+        self._metrics = []
+
+    def register(self, m):
+        self._metrics.append(m)
+
+    def expose(self) -> str:
+        lines: list[str] = []
+        for m in self._metrics:
+            lines.extend(m.collect())
+        return "\n".join(lines) + "\n"
+
+
+class EngineMetrics:
+    """The engine's metric family (names mirror vLLM's so the KEDA
+    scaler/EPP configs translate 1:1)."""
+
+    def __init__(self, engine=None):
+        self.registry = Registry()
+        r = self.registry
+        self.prompt_tokens = Counter(
+            "kaito:prompt_tokens_total", "Prefill tokens processed", r)
+        self.generation_tokens = Counter(
+            "kaito:generation_tokens_total", "Tokens generated", r)
+        self.request_success = Counter(
+            "kaito:request_success_total", "Requests finished", r,
+            labels=("finished_reason",))
+        self.requests_rejected = Counter(
+            "kaito:request_rejected_total", "Requests rejected (rate limit)", r)
+        self.ttft = Histogram(
+            "kaito:time_to_first_token_seconds", "Time to first token", r)
+        self.tpot = Histogram(
+            "kaito:time_per_output_token_seconds", "Inter-token latency", r,
+            buckets=(0.002, 0.005, 0.01, 0.02, 0.04, 0.06, 0.08, 0.1, 0.25,
+                     0.5, 1.0))
+        self.e2e_latency = Histogram(
+            "kaito:e2e_request_latency_seconds", "End-to-end request latency", r)
+        if engine is not None:
+            Gauge("kaito:num_requests_running", "Active decode slots", r,
+                  fn=lambda: engine.num_running)
+            Gauge("kaito:num_requests_waiting", "Queued requests", r,
+                  fn=lambda: engine.num_waiting)
+            Gauge("kaito:kv_cache_usage_perc", "KV page pool usage", r,
+                  fn=lambda: 1.0 - engine.allocator.available /
+                  max(engine.allocator.num_pages - 1, 1))
+            Gauge("kaito:kv_pages_total", "Total KV pages", r,
+                  fn=lambda: engine.allocator.num_pages - 1)
+
+    def observe_request(self, req) -> None:
+        if req.first_token_time:
+            self.ttft.observe(req.first_token_time - req.submit_time)
+        if req.finish_time:
+            self.e2e_latency.observe(req.finish_time - req.submit_time)
+            n_out = len(req.output_tokens)
+            if req.first_token_time and n_out > 1:
+                self.tpot.observe(
+                    (req.finish_time - req.first_token_time) / (n_out - 1))
+            self.request_success.inc(finished_reason=req.finish_reason or "stop")
+        self.prompt_tokens.inc(len(req.prompt_tokens))
+        self.generation_tokens.inc(len(req.output_tokens))

@@ -1,0 +1,21 @@
+"""Queue-depth rate limiting.
+
+Same contract as the reference's vLLM wrapper rate limiter
+(``presets/workspace/inference/vllm/rate_limit.py`` +
+``--kaito-disable-rate-limit``): when the number of queued-but-not-
+running requests exceeds the cap, new work is rejected with HTTP 429 so
+the Gateway/EPP retries another replica instead of piling onto this one.
+"""
+
+from __future__ import annotations
+
+
+class RateLimiter:
+    def __init__(self, max_queue_len: int, disabled: bool = False):
+        self.max_queue_len = max_queue_len
+        self.disabled = disabled
+
+    def admit(self, num_waiting: int) -> bool:
+        if self.disabled:
+            return True
+        return num_waiting < self.max_queue_len

@@ -1,0 +1,346 @@
+"""OpenAI-compatible HTTP server.
+
+The front door of the in-pod runtime — same contract the reference's
+vLLM wrapper exposes on port 5000 (``presets/workspace/inference/vllm/
+inference_api.py``): ``/v1/completions``, ``/v1/chat/completions`` (with
+SSE streaming), ``/v1/models``, ``/health``, Prometheus ``/metrics``,
+KAITO config-file merge, LoRA adapter directory discovery, and
+queue-depth 429 rate limiting.  Stdlib HTTP only — the engine thread
+does the work; handler threads just stream queues.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from kaito_tpu.engine.chat import render_chat
+from kaito_tpu.engine.config import EngineConfig
+from kaito_tpu.engine.engine import InferenceEngine, SamplingParams
+from kaito_tpu.engine.metrics import EngineMetrics
+from kaito_tpu.engine.rate_limit import RateLimiter
+
+logger = logging.getLogger(__name__)
+
+
+def discover_adapters(adapters_dir: str) -> dict[str, str]:
+    """Find LoRA adapters: subdirectories holding an adapter config
+    (reference behavior: ``inference_api.py`` load_lora_adapters scans
+    --kaito-adapters-dir)."""
+    found: dict[str, str] = {}
+    if not adapters_dir or not os.path.isdir(adapters_dir):
+        return found
+    for name in sorted(os.listdir(adapters_dir)):
+        path = os.path.join(adapters_dir, name)
+        if os.path.isdir(path) and (
+            os.path.exists(os.path.join(path, "adapter_config.json"))
+            or os.path.exists(os.path.join(path, "adapter.msgpack"))
+        ):
+            found[name] = path
+    return found
+
+
+class ServerState:
+    def __init__(self, engine: InferenceEngine, cfg: EngineConfig):
+        self.engine = engine
+        self.cfg = cfg
+        self.metrics = EngineMetrics(engine)
+        self.limiter = RateLimiter(cfg.max_queue_len, cfg.disable_rate_limit)
+        self.model_name = cfg.served_model_name or engine.md.name
+        self.adapters = discover_adapters(cfg.adapters_dir)
+        self.started = time.time()
+
+
+class OpenAIHandler(BaseHTTPRequestHandler):
+    state: ServerState  # injected via server factory
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # route through logging, not stderr
+        logger.debug("%s " + fmt, self.address_string(), *args)
+
+    # ---------------- helpers ----------------
+
+    def _json(self, code: int, obj: dict):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, code: int, message: str, etype: str = "invalid_request_error"):
+        self._json(code, {"error": {"message": message, "type": etype}})
+
+    def _read_body(self) -> Optional[dict]:
+        try:
+            n = int(self.headers.get("Content-Length", "0"))
+            raw = self.rfile.read(n) if n else b"{}"
+            return json.loads(raw or b"{}")
+        except (ValueError, json.JSONDecodeError):
+            self._error(400, "invalid JSON body")
+            return None
+
+    def _sse_start(self):
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+    def _sse_send(self, obj) -> None:
+        data = b"data: " + (obj if isinstance(obj, bytes) else
+                            json.dumps(obj).encode()) + b"\n\n"
+        self.wfile.write(b"%x\r\n%s\r\n" % (len(data), data))
+
+    def _sse_end(self):
+        data = b"data: [DONE]\n\n"
+        self.wfile.write(b"%x\r\n%s\r\n" % (len(data), data))
+        self.wfile.write(b"0\r\n\r\n")
+
+    # ---------------- routes ----------------
+
+    def do_GET(self):
+        st = self.state
+        if self.path == "/health":
+            self._json(200, {"status": "ok"})
+        elif self.path == "/metrics":
+            body = st.metrics.registry.expose().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif self.path == "/v1/models":
+            models = [{"id": st.model_name, "object": "model",
+                       "owned_by": "kaito-tpu", "root": st.model_name}]
+            for name in st.adapters:
+                models.append({"id": name, "object": "model",
+                               "owned_by": "kaito-tpu", "parent": st.model_name})
+            self._json(200, {"object": "list", "data": models})
+        else:
+            self._error(404, f"no route {self.path}")
+
+    def do_POST(self):
+        if self.path == "/v1/completions":
+            self._completions(chat=False)
+        elif self.path == "/v1/chat/completions":
+            self._completions(chat=True)
+        else:
+            self._error(404, f"no route {self.path}")
+
+    # ---------------- generation ----------------
+
+    def _completions(self, chat: bool):
+        st = self.state
+        body = self._read_body()
+        if body is None:
+            return
+        if not st.limiter.admit(st.engine.num_waiting):
+            st.metrics.requests_rejected.inc()
+            self._error(429, "engine queue full, retry later", "rate_limit_error")
+            return
+
+        try:
+            if chat:
+                messages = body.get("messages")
+                if not isinstance(messages, list) or not messages:
+                    return self._error(400, "'messages' must be a non-empty list")
+                prompt_text = render_chat(st.engine.tokenizer, messages)
+            else:
+                prompt = body.get("prompt", "")
+                if isinstance(prompt, list):
+                    prompt = prompt[0] if prompt else ""
+                if not isinstance(prompt, str) or prompt == "":
+                    return self._error(400, "'prompt' must be a non-empty string")
+                prompt_text = prompt
+
+            params = SamplingParams(
+                max_tokens=int(body.get("max_tokens") or 128),
+                temperature=float(body.get("temperature", 1.0)),
+                top_k=int(body.get("top_k", 0) or 0),
+                top_p=float(body.get("top_p", 1.0)),
+                seed=int(body.get("seed", 0) or 0),
+            )
+        except (TypeError, ValueError) as e:
+            return self._error(400, f"bad parameter: {e}")
+
+        stop = body.get("stop")
+        stop_strs = [stop] if isinstance(stop, str) else list(stop or [])
+        tokens = st.engine.tokenizer.encode(prompt_text)
+        try:
+            req = st.engine.submit(tokens, params,
+                                   req_id=f"cmpl-{uuid.uuid4().hex[:20]}")
+        except ValueError as e:
+            return self._error(400, str(e))
+
+        stream = bool(body.get("stream", False))
+        created = int(time.time())
+        obj = "chat.completion" if chat else "text_completion"
+        base = {"id": req.req_id, "object": obj + (".chunk" if stream else ""),
+                "created": created, "model": body.get("model") or st.model_name}
+
+        if stream:
+            self._sse_start()
+            if chat:
+                first = dict(base)
+                first["choices"] = [{"index": 0, "delta": {"role": "assistant"},
+                                     "finish_reason": None}]
+                self._sse_send(first)
+            sent_text = ""
+            ids: list[int] = []
+            stopped = False
+            for tok in req.stream():
+                ids.append(tok)
+                text = st.engine.tokenizer.decode(ids)
+                if text.endswith("�"):
+                    continue  # mid-codepoint; wait for more bytes
+                delta = text[len(sent_text):]
+                sent_text = text
+                if stop_strs and any(s in sent_text for s in stop_strs):
+                    cut = min(sent_text.find(s) for s in stop_strs
+                              if s in sent_text)
+                    delta = sent_text[:cut][len(sent_text) - len(delta):]
+                    req.aborted = True
+                    stopped = True
+                if delta:
+                    chunk = dict(base)
+                    chunk["choices"] = [{
+                        "index": 0,
+                        **({"delta": {"content": delta}} if chat else {"text": delta}),
+                        "finish_reason": None}]
+                    self._sse_send(chunk)
+                if stopped:
+                    break
+            # flush text withheld by the mid-codepoint guard
+            if not stopped and ids:
+                tail = st.engine.tokenizer.decode(ids)[len(sent_text):]
+                if tail:
+                    chunk = dict(base)
+                    chunk["choices"] = [{
+                        "index": 0,
+                        **({"delta": {"content": tail}} if chat else {"text": tail}),
+                        "finish_reason": None}]
+                    self._sse_send(chunk)
+            fin = dict(base)
+            fin["choices"] = [{"index": 0,
+                               **({"delta": {}} if chat else {"text": ""}),
+                               "finish_reason": "stop" if stopped else
+                               (req.finish_reason or "stop")}]
+            self._sse_send(fin)
+            self._sse_end()
+            st.metrics.observe_request(req)
+            return
+
+        out_ids = list(req.stream())
+        text = st.engine.tokenizer.decode(out_ids)
+        finish = req.finish_reason or "stop"
+        for s in stop_strs:
+            if s in text:
+                text = text[: text.find(s)]
+                finish = "stop"
+        usage = {"prompt_tokens": len(tokens),
+                 "completion_tokens": len(out_ids),
+                 "total_tokens": len(tokens) + len(out_ids)}
+        if chat:
+            choice = {"index": 0, "message": {"role": "assistant", "content": text},
+                      "finish_reason": finish}
+        else:
+            choice = {"index": 0, "text": text, "logprobs": None,
+                      "finish_reason": finish}
+        resp = dict(base)
+        resp.update({"choices": [choice], "usage": usage})
+        st.metrics.observe_request(req)
+        self._json(200, resp)
+
+
+def make_server(engine: InferenceEngine, cfg: EngineConfig,
+                host: str = "0.0.0.0", port: Optional[int] = None) -> ThreadingHTTPServer:
+    state = ServerState(engine, cfg)
+    handler = type("Handler", (OpenAIHandler,), {"state": state})
+    server = ThreadingHTTPServer((host, port if port is not None else cfg.port),
+                                 handler)
+    server.state = state  # type: ignore[attr-defined]
+    return server
+
+
+def load_config_file(cfg: EngineConfig, path: str) -> EngineConfig:
+    """Merge a KAITO config YAML over the engine config (same mechanism
+    as the reference's --kaito-config-file: user YAML from the Workspace
+    ``inference.config`` ConfigMap wins over defaults)."""
+    import yaml
+
+    with open(path) as f:
+        data = yaml.safe_load(f) or {}
+    section = data.get("vllm") or data.get("engine") or data
+    mapped = {}
+    alias = {
+        "max-model-len": "max_model_len", "max_model_len": "max_model_len",
+        "max-num-seqs": "max_num_seqs", "max_num_seqs": "max_num_seqs",
+        "served-model-name": "served_model_name",
+        "served_model_name": "served_model_name",
+        "tensor-parallel-size": "tensor_parallel",
+        "tensor_parallel_size": "tensor_parallel",
+        "data-parallel-size": "data_parallel",
+        "data_parallel_size": "data_parallel",
+        "page-size": "page_size", "page_size": "page_size",
+        "dtype": "dtype", "kv-cache-dtype": "kv_dtype",
+        "seed": "seed", "port": "port",
+    }
+    for k, v in (section or {}).items():
+        if k in alias and v is not None:
+            mapped[alias[k]] = v
+    return cfg.replace(**mapped)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="kaito-tpu-serve")
+    ap.add_argument("--model", default="tiny-llama-test")
+    ap.add_argument("--port", type=int, default=5000)
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--max-model-len", type=int, default=0)
+    ap.add_argument("--max-num-seqs", type=int, default=8)
+    ap.add_argument("--served-model-name", default="")
+    ap.add_argument("--dtype", default="")
+    ap.add_argument("--kaito-config-file", default="")
+    ap.add_argument("--kaito-adapters-dir", default="")
+    ap.add_argument("--kaito-disable-rate-limit", action="store_true")
+    ap.add_argument("--max-queue-len", type=int, default=256)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    on_tpu = jax.devices()[0].platform not in ("cpu",)
+    cfg = EngineConfig(
+        model=args.model, port=args.port, max_model_len=args.max_model_len,
+        max_num_seqs=args.max_num_seqs, served_model_name=args.served_model_name,
+        dtype=args.dtype or ("bfloat16" if on_tpu else "float32"),
+        kv_dtype=args.dtype or ("bfloat16" if on_tpu else "float32"),
+        adapters_dir=args.kaito_adapters_dir,
+        disable_rate_limit=args.kaito_disable_rate_limit,
+        max_queue_len=args.max_queue_len,
+    )
+    if args.kaito_config_file:
+        cfg = load_config_file(cfg, args.kaito_config_file)
+
+    logging.basicConfig(level=logging.INFO)
+    engine = InferenceEngine(cfg)
+    engine.start()
+    server = make_server(engine, cfg, host=args.host)
+    logger.info("serving %s on %s:%d", cfg.model, args.host, cfg.port)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        engine.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,156 @@
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kaito_tpu.engine.config import EngineConfig
+from kaito_tpu.engine.engine import InferenceEngine
+from kaito_tpu.engine.server import make_server
+
+
+@pytest.fixture(scope="module")
+def served():
+    cfg = EngineConfig(
+        model="tiny-llama-test", max_model_len=256, page_size=16,
+        max_num_seqs=4, dtype="float32", kv_dtype="float32",
+        prefill_buckets=(32, 64, 128), served_model_name="tiny")
+    engine = InferenceEngine(cfg)
+    engine.start()
+    server = make_server(engine, cfg, host="127.0.0.1", port=0)
+    port = server.server_address[1]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{port}", engine
+    server.shutdown()
+    engine.stop()
+
+
+def _post(url, path, body, raw=False):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    resp = urllib.request.urlopen(req, timeout=120)
+    if raw:
+        return resp
+    return json.loads(resp.read())
+
+
+def _get(url, path):
+    return urllib.request.urlopen(url + path, timeout=30)
+
+
+def test_health_and_models(served):
+    url, _ = served
+    assert json.loads(_get(url, "/health").read())["status"] == "ok"
+    models = json.loads(_get(url, "/v1/models").read())
+    assert models["data"][0]["id"] == "tiny"
+
+
+def test_completions_sync(served):
+    url, _ = served
+    out = _post(url, "/v1/completions", {
+        "prompt": "hello world", "max_tokens": 8, "temperature": 0.0,
+    })
+    assert out["object"] == "text_completion"
+    assert out["usage"]["completion_tokens"] >= 1
+    assert out["choices"][0]["finish_reason"] in ("stop", "length")
+    assert isinstance(out["choices"][0]["text"], str)
+
+
+def test_chat_completions_sync(served):
+    url, _ = served
+    out = _post(url, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "hi"}],
+        "max_tokens": 6, "temperature": 0.0,
+    })
+    assert out["choices"][0]["message"]["role"] == "assistant"
+    assert out["usage"]["total_tokens"] > 0
+
+
+def test_chat_stream_sse(served):
+    url, _ = served
+    resp = _post(url, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "hi"}],
+        "max_tokens": 6, "temperature": 0.0, "stream": True,
+    }, raw=True)
+    assert resp.headers["Content-Type"].startswith("text/event-stream")
+    events = []
+    for line in resp:
+        line = line.strip()
+        if line.startswith(b"data: "):
+            events.append(line[6:])
+    assert events[-1] == b"[DONE]"
+    first = json.loads(events[0])
+    assert first["choices"][0]["delta"].get("role") == "assistant"
+    fin = json.loads(events[-2])
+    assert fin["choices"][0]["finish_reason"] in ("stop", "length")
+
+
+def test_bad_requests(served):
+    url, _ = served
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(url, "/v1/completions", {"prompt": ""})
+    assert e.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(url, "/v1/chat/completions", {"messages": []})
+    assert e.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(url, "/v1/completions", {"prompt": "x" * 100000, "max_tokens": 1})
+    assert e.value.code == 400  # prompt exceeds max_model_len
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(url, "/nope")
+    assert e.value.code == 404
+
+
+def test_metrics_exposition(served):
+    url, _ = served
+    body = _get(url, "/metrics").read().decode()
+    assert "kaito:generation_tokens_total" in body
+    assert "kaito:num_requests_running" in body
+    assert "kaito:kv_cache_usage_perc" in body
+    assert "kaito:time_to_first_token_seconds_bucket" in body
+
+
+def test_rate_limit_429():
+    from kaito_tpu.engine.rate_limit import RateLimiter
+
+    lim = RateLimiter(max_queue_len=2)
+    assert lim.admit(0) and lim.admit(1)
+    assert not lim.admit(2)
+    assert RateLimiter(0, disabled=True).admit(100)
+
+
+def test_stop_string(served):
+    url, _ = served
+    full = _post(url, "/v1/completions", {
+        "prompt": "abc", "max_tokens": 10, "temperature": 0.0})
+    text = full["choices"][0]["text"]
+    if len(text) >= 3:
+        stop = text[1]
+        out = _post(url, "/v1/completions", {
+            "prompt": "abc", "max_tokens": 10, "temperature": 0.0,
+            "stop": [stop]})
+        assert stop not in out["choices"][0]["text"]
+
+
+def test_config_file_merge(tmp_path):
+    from kaito_tpu.engine.server import load_config_file
+
+    p = tmp_path / "cfg.yaml"
+    p.write_text("max-model-len: 512\nmax_num_seqs: 16\nserved-model-name: foo\n")
+    cfg = load_config_file(EngineConfig(), str(p))
+    assert cfg.max_model_len == 512
+    assert cfg.max_num_seqs == 16
+    assert cfg.served_model_name == "foo"
+
+
+def test_adapter_discovery(tmp_path):
+    from kaito_tpu.engine.server import discover_adapters
+
+    (tmp_path / "style-a").mkdir()
+    (tmp_path / "style-a" / "adapter_config.json").write_text("{}")
+    (tmp_path / "not-adapter").mkdir()
+    found = discover_adapters(str(tmp_path))
+    assert list(found) == ["style-a"]
