@@ -1,0 +1,15 @@
+from kaito_tpu.api.meta import Condition, ObjectMeta, now_iso  # noqa: F401
+from kaito_tpu.api.workspace import (  # noqa: F401
+    InferenceSpec,
+    ResourceSpec,
+    TuningSpec,
+    Workspace,
+    WorkspaceStatus,
+)
+from kaito_tpu.api.inferenceset import InferenceSet, InferenceSetSpec  # noqa: F401
+from kaito_tpu.api.ragengine import RAGEngine, RAGEngineSpec  # noqa: F401
+from kaito_tpu.api.multiroleinference import (  # noqa: F401
+    MultiRoleInference,
+    RoleSpec,
+)
+from kaito_tpu.api.modelmirror import ModelMirror, ModelMirrorSpec  # noqa: F401

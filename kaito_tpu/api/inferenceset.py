@@ -1,0 +1,82 @@
+"""InferenceSet: replicated Workspaces with autoscale surface.
+
+Parity with ``api/v1beta1/inferenceset_types.go:39-165``: replicas +
+workspace template + selector for the HPA/KEDA scale subresource,
+nodeCountLimit guard, rolling update strategy, auto-upgrade maintenance
+window (cron).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from kaito_tpu.api.meta import Condition, KaitoObject, ObjectMeta
+from kaito_tpu.api.workspace import InferenceSpec, ResourceSpec
+
+
+@dataclass
+class MaintenanceWindow:
+    cron: str = ""              # 5-field cron in UTC
+    duration_minutes: int = 60
+
+
+@dataclass
+class AutoUpgradePolicy:
+    enabled: bool = False
+    maintenance_window: MaintenanceWindow = field(default_factory=MaintenanceWindow)
+
+
+@dataclass
+class WorkspaceTemplate:
+    resource: ResourceSpec = field(default_factory=ResourceSpec)
+    inference: InferenceSpec = field(default_factory=InferenceSpec)
+    labels: dict[str, str] = field(default_factory=dict)
+    annotations: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class InferenceSetSpec:
+    replicas: int = 1
+    template: WorkspaceTemplate = field(default_factory=WorkspaceTemplate)
+    node_count_limit: int = 0           # 0 = unlimited
+    update_strategy: str = "RollingUpdate"
+    auto_upgrade: AutoUpgradePolicy = field(default_factory=AutoUpgradePolicy)
+
+
+@dataclass
+class InferenceSetStatus:
+    replicas: int = 0
+    ready_replicas: int = 0
+    selector: str = ""                  # scale-subresource label selector
+    conditions: list[Condition] = field(default_factory=list)
+    aggregated_peak_tokens_per_minute: float = 0.0
+
+
+class InferenceSet(KaitoObject):
+    kind = "InferenceSet"
+
+    def __init__(self, meta: ObjectMeta, spec: Optional[InferenceSetSpec] = None):
+        super().__init__(meta)
+        self.spec = spec or InferenceSetSpec()
+        self.status = InferenceSetStatus()
+
+    def default(self) -> None:
+        if self.spec.replicas < 0:
+            self.spec.replicas = 0
+        if not self.spec.update_strategy:
+            self.spec.update_strategy = "RollingUpdate"
+
+    def validate(self) -> list[str]:
+        errs = []
+        if self.spec.replicas < 0:
+            errs.append("spec.replicas must be >= 0")
+        if self.spec.update_strategy not in ("RollingUpdate", "OnDelete"):
+            errs.append(f"spec.updateStrategy {self.spec.update_strategy!r} invalid")
+        if self.spec.node_count_limit < 0:
+            errs.append("spec.nodeCountLimit must be >= 0")
+        if self.spec.auto_upgrade.enabled and not self.spec.auto_upgrade.maintenance_window.cron:
+            errs.append("autoUpgrade.maintenanceWindow.cron required when enabled")
+        if not self.spec.template.inference.preset and self.spec.template.inference.template is None:
+            errs.append("template.inference.preset or template is required")
+        return errs

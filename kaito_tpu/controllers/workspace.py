@@ -1,0 +1,293 @@
+"""Workspace reconciler.
+
+The core orchestration loop (reference:
+``pkg/workspace/controllers/workspace_controller.go:116`` Reconcile):
+finalizer → ControllerRevision → plan slice via estimator/planner →
+provision TPU capacity → gate on ModelMirror → render + apply workload
+→ sync conditions/status.  The mesh planner replaces the reference's
+EstimateNodeCount + configureParallelism pair: a single decision
+produces both the capacity ask and the parallelism layout.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from kaito_tpu.api.meta import Condition, ObjectMeta, set_condition
+from kaito_tpu.api.modelmirror import (
+    PHASE_READY,
+    ModelMirror,
+    ModelMirrorSpec,
+    MirrorSource,
+)
+from kaito_tpu.api.workspace import (
+    ANNOTATION_DISABLE_BENCHMARK,
+    ANNOTATION_UPGRADE_TO,
+    COND_BENCHMARK_COMPLETE,
+    COND_INFERENCE_READY,
+    COND_NODE_CLAIM_READY,
+    COND_RESOURCE_READY,
+    COND_TUNING_STARTED,
+    COND_WORKSPACE_SUCCEEDED,
+    LABEL_WORKSPACE_NAME,
+    Workspace,
+)
+from kaito_tpu.controllers.objects import Unstructured
+from kaito_tpu.controllers.runtime import (
+    Reconciler,
+    Result,
+    Store,
+    sync_controller_revision,
+    update_with_retry,
+)
+from kaito_tpu.manifests.inference import generate_inference_workload
+from kaito_tpu.manifests.tuning_job import generate_tuning_job
+from kaito_tpu.models.registry import get_model_by_name
+from kaito_tpu.parallel.plan import ParallelPlan, plan_parallelism
+from kaito_tpu.provision.provisioner import ProvisionRequest
+from kaito_tpu.sku.catalog import (
+    MACHINE_TYPES,
+    CHIP_CATALOG,
+    TPUSliceSpec,
+    get_tpu_config_from_node_labels,
+)
+
+logger = logging.getLogger(__name__)
+
+FINALIZER = "kaito-tpu.io/workspace-finalizer"
+BENCH_METRIC_PEAK_TPM = "peakTokensPerMinute"
+
+
+class WorkspaceReconciler(Reconciler):
+    kind = "Workspace"
+
+    def __init__(self, store: Store, provisioner, feature_gates=None):
+        super().__init__(store)
+        self.provisioner = provisioner
+        self.gates = feature_gates or {}
+
+    # ------------------------------------------------------------------
+
+    def reconcile(self, ws: Workspace) -> Result:
+        if ws.metadata.deletion_timestamp:
+            return self._finalize(ws)
+        if FINALIZER not in ws.metadata.finalizers:
+            ws.metadata.finalizers.append(FINALIZER)
+            ws = self.store.update(ws)
+
+        ws.default()
+        errs = ws.validate()
+        if errs:
+            self._set_cond(ws, COND_RESOURCE_READY, "False",
+                           "ValidationFailed", "; ".join(errs))
+            return Result()
+
+        sync_controller_revision(self.store, ws, ws.revision_payload())
+
+        try:
+            md, plan, slice_spec = self._plan(ws)
+        except (KeyError, ValueError) as e:
+            self._set_cond(ws, COND_RESOURCE_READY, "False", "PlanFailed", str(e))
+            return Result()
+
+        # capacity
+        req = ProvisionRequest(
+            owner_name=ws.metadata.name,
+            owner_namespace=ws.metadata.namespace,
+            slice_spec=slice_spec,
+            num_slices=plan.num_slices * ws.resource.count,
+            extra_labels=dict(ws.resource.label_selector),
+            preferred_nodes=list(ws.resource.preferred_nodes))
+        self.provisioner.provision(req)
+        ready, nodes = self.provisioner.ensure_ready(req)
+
+        def set_target(o):
+            o.status.target_node_count = plan.num_hosts * ws.resource.count
+            o.status.worker_nodes = nodes
+            o.status.observed_generation = o.metadata.generation
+        ws = update_with_retry(self.store, "Workspace", ws.metadata.namespace,
+                               ws.metadata.name, set_target)
+
+        if not ready:
+            self._set_cond(ws, COND_NODE_CLAIM_READY, "False",
+                           "Provisioning", f"{len(nodes)} nodes ready")
+            return Result(requeue_after=5.0)
+        self._set_cond(ws, COND_NODE_CLAIM_READY, "True", "NodesReady",
+                       f"{len(nodes)} nodes ready")
+        self._set_cond(ws, COND_RESOURCE_READY, "True", "ResourceReady", "")
+
+        # weight cache gate (reference: ensureModelMirror :173 +
+        # waitForModelMirror :291, behind the ModelMirror feature gate)
+        if self.gates.get("modelMirror") and md.hf_id:
+            if not self._ensure_model_mirror(md):
+                return Result(requeue_after=5.0)
+
+        if ws.tuning is not None:
+            return self._reconcile_tuning(ws, md, plan, req)
+        return self._reconcile_inference(ws, md, plan, req)
+
+    # ------------------------------------------------------------------
+
+    def _plan(self, ws: Workspace):
+        md = get_model_by_name(ws.preset_name)
+        entry = MACHINE_TYPES.get(ws.resource.instance_type)
+        if entry is not None:
+            chip = CHIP_CATALOG[entry[0]]
+        else:
+            # BYO path: derive chip from an existing labeled node
+            spec = None
+            for n in self.store.list("Node", labels=ws.resource.label_selector or None):
+                spec = get_tpu_config_from_node_labels(n.metadata.labels)
+                if spec:
+                    break
+            if spec is None:
+                raise ValueError(
+                    f"cannot determine TPU generation for {ws.metadata.name}: "
+                    f"unknown instance type and no labeled BYO nodes")
+            chip = spec.chip
+        workload = "train" if ws.tuning is not None else "serve"
+        target = None
+        if ws.resource.tpu_topology:
+            from kaito_tpu.sku.catalog import topology_chips
+
+            target = topology_chips(ws.resource.tpu_topology)
+        plan = plan_parallelism(md, chip, workload=workload, target_chips=target)
+        slice_spec = TPUSliceSpec(
+            chip=chip, topology=plan.topology,
+            machine_type=ws.resource.instance_type
+            if ws.resource.instance_type in MACHINE_TYPES else "")
+        return md, plan, slice_spec
+
+    def _ensure_model_mirror(self, md) -> bool:
+        name = md.name.replace("/", "-")
+        mirror = self.store.try_get("ModelMirror", "", name)
+        if mirror is None:
+            self.store.create(ModelMirror(
+                ObjectMeta(name=name, namespace=""),
+                ModelMirrorSpec(source=MirrorSource(model_id=md.hf_id))))
+            return False
+        return mirror.status.phase == PHASE_READY
+
+    # ------------------------------------------------------------------
+
+    def _reconcile_inference(self, ws: Workspace, md, plan: ParallelPlan,
+                             req: ProvisionRequest) -> Result:
+        node_selector = self.provisioner.node_selector(req)
+        benchmark = ws.metadata.annotations.get(ANNOTATION_DISABLE_BENCHMARK) != "true"
+        objs = generate_inference_workload(ws, md, plan, node_selector,
+                                           benchmark=benchmark)
+        for obj in objs:
+            self._apply(obj, ws)
+
+        # image upgrade (reference: workspace_controller.go:676-685)
+        upgrade_to = ws.metadata.annotations.get(ANNOTATION_UPGRADE_TO)
+        if upgrade_to:
+            def bump(ss):
+                c = ss.spec["template"]["spec"]["containers"][0]
+                base = c["image"].rsplit(":", 1)[0]
+                c["image"] = f"{base}:{upgrade_to}"
+            update_with_retry(self.store, "StatefulSet", ws.metadata.namespace,
+                              ws.metadata.name, bump)
+
+        ss = self.store.try_get("StatefulSet", ws.metadata.namespace,
+                                ws.metadata.name)
+        ready = bool(ss) and ss.status.get("readyReplicas", 0) >= ss.spec["replicas"]
+        self._set_cond(ws, COND_INFERENCE_READY, "True" if ready else "False",
+                       "InferenceReady" if ready else "PodsPending",
+                       f"{(ss.status.get('readyReplicas', 0) if ss else 0)}"
+                       f"/{plan.num_hosts} ready")
+
+        # benchmark result ingestion (reference: benchmark.go tails pod
+        # logs for KAITO_BENCHMARK_RESULT; our probe posts to the SS
+        # status, same contract re-homed)
+        bench = (ss.status.get("benchmark") if ss else None) or {}
+        if benchmark and ready and bench:
+            def record(o):
+                o.status.performance.metrics[BENCH_METRIC_PEAK_TPM] = float(
+                    bench.get("total_tpm", 0.0))
+                o.status.performance.config = {
+                    k: str(v) for k, v in bench.items() if k != "total_tpm"}
+            ws = update_with_retry(self.store, "Workspace",
+                                   ws.metadata.namespace, ws.metadata.name,
+                                   record)
+            self._set_cond(ws, COND_BENCHMARK_COMPLETE, "True",
+                           "BenchmarkComplete", "")
+        if ready:
+            self._set_cond(ws, COND_WORKSPACE_SUCCEEDED, "True", "Ready", "")
+        return Result() if ready else Result(requeue_after=5.0)
+
+    def _reconcile_tuning(self, ws: Workspace, md, plan: ParallelPlan,
+                          req: ProvisionRequest) -> Result:
+        node_selector = self.provisioner.node_selector(req)
+        job = generate_tuning_job(ws, md, plan, node_selector)
+        self._apply(job, ws)
+        self._set_cond(ws, COND_TUNING_STARTED, "True", "JobCreated", "")
+        live = self.store.try_get("Job", ws.metadata.namespace, job.metadata.name)
+        if live and live.status.get("succeeded"):
+            self._set_cond(ws, COND_WORKSPACE_SUCCEEDED, "True", "JobSucceeded", "")
+            return Result()
+        if live and live.status.get("failed"):
+            self._set_cond(ws, COND_WORKSPACE_SUCCEEDED, "False", "JobFailed",
+                           str(live.status.get("message", "")))
+            return Result()
+        return Result(requeue_after=5.0)
+
+    # ------------------------------------------------------------------
+
+    def _apply(self, obj: Unstructured, owner: Workspace) -> None:
+        """Create-or-selectively-update (reference: selective field
+        update, workspace_controller.go:655-668 — replicas/template only,
+        so external controllers' fields survive)."""
+        obj.metadata.owner_references = [{
+            "kind": "Workspace", "name": owner.metadata.name,
+            "uid": owner.metadata.uid}]
+        existing = self.store.try_get(obj.kind, obj.metadata.namespace,
+                                      obj.metadata.name)
+        if existing is None:
+            self.store.create(obj)
+            return
+        if obj.kind == "StatefulSet":
+            def mutate(cur):
+                cur.spec["replicas"] = obj.spec["replicas"]
+                # keep a live image upgrade (annotation path) sticky
+                new_tmpl = obj.spec["template"]
+                cur_img = cur.spec["template"]["spec"]["containers"][0].get("image")
+                new_tmpl["spec"]["containers"][0]["image"] = cur_img or \
+                    new_tmpl["spec"]["containers"][0]["image"]
+                cur.spec["template"] = new_tmpl
+            update_with_retry(self.store, obj.kind, obj.metadata.namespace,
+                              obj.metadata.name, mutate)
+
+    def _set_cond(self, ws: Workspace, type_: str, status: str, reason: str,
+                  message: str) -> None:
+        def mutate(o):
+            set_condition(o.status.conditions, Condition(
+                type=type_, status=status, reason=reason, message=message,
+                observed_generation=o.metadata.generation))
+        update_with_retry(self.store, "Workspace", ws.metadata.namespace,
+                          ws.metadata.name, mutate)
+
+    def _finalize(self, ws: Workspace) -> Result:
+        try:
+            md, plan, slice_spec = self._plan(ws)
+            req = ProvisionRequest(
+                owner_name=ws.metadata.name,
+                owner_namespace=ws.metadata.namespace,
+                slice_spec=slice_spec, num_slices=plan.num_slices)
+            self.provisioner.deprovision(req)
+        except Exception:
+            logger.exception("deprovision during finalize failed; continuing")
+        for kind in ("StatefulSet", "Service", "Job"):
+            for obj in self.store.list(kind, ws.metadata.namespace):
+                if any(ref.get("name") == ws.metadata.name
+                       for ref in obj.metadata.owner_references):
+                    self.store.delete(kind, obj.metadata.namespace,
+                                      obj.metadata.name)
+        if FINALIZER in ws.metadata.finalizers:
+            def strip(o):
+                if FINALIZER in o.metadata.finalizers:
+                    o.metadata.finalizers.remove(FINALIZER)
+            update_with_retry(self.store, "Workspace", ws.metadata.namespace,
+                              ws.metadata.name, strip)
+        return Result()

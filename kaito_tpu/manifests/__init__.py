@@ -1,0 +1,9 @@
+from kaito_tpu.manifests.inference import (  # noqa: F401
+    build_engine_command,
+    generate_inference_workload,
+)
+from kaito_tpu.manifests.core import (  # noqa: F401
+    generate_service,
+    generate_headless_service,
+    generate_statefulset,
+)

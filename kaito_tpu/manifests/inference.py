@@ -1,0 +1,184 @@
+"""Inference workload generation: ParallelPlan -> StatefulSet + Services.
+
+The TPU-native re-design of ``pkg/workspace/inference/
+preset_inferences.go:158`` (GeneratePresetInference) and the command
+builder ``pkg/model/interface.go:340-560``: instead of rendering vLLM
+flags + a Ray bootstrap script, we render the engine server command
+with the planner's mesh baked into env/flags, and rely on GKE's
+TPU_WORKER_ID / TPU_WORKER_HOSTNAMES injection plus the headless
+service for the JAX coordinator.
+"""
+
+from __future__ import annotations
+
+import shlex
+from typing import Optional
+
+from kaito_tpu.api.workspace import LABEL_WORKSPACE_NAME, Workspace
+from kaito_tpu.manifests.core import (
+    generate_headless_service,
+    generate_service,
+    generate_statefulset,
+)
+from kaito_tpu.models.metadata import ModelMetadata
+from kaito_tpu.parallel.plan import ParallelPlan
+
+DEFAULT_IMAGE = "ghcr.io/kaito-tpu/engine:latest"
+PORT = 5000
+
+
+def coordinator_address(workspace_name: str, namespace: str) -> str:
+    """Pod-0 DNS via the headless service — same convention the
+    reference uses for the Ray leader (``pkg/utils/common.go:229``),
+    reused as the JAX distributed coordinator."""
+    return (f"{workspace_name}-0.{workspace_name}-headless."
+            f"{namespace}.svc.cluster.local:8476")
+
+
+def build_engine_command(
+    ws: Workspace,
+    md: ModelMetadata,
+    plan: ParallelPlan,
+    *,
+    config_file: str = "",
+    adapters_dir: str = "",
+) -> list[str]:
+    """The pod command (analogue of buildVLLMInferenceCommand
+    ``pkg/model/interface.go:374`` + configureParallelism ``:500``)."""
+    mesh = plan.mesh
+    args = [
+        "python", "-m", "kaito_tpu.engine.server",
+        "--model", md.name if md.name else md.hf_id,
+        "--port", str(PORT),
+        "--max-model-len", str(plan.max_model_len),
+    ]
+    if config_file:
+        args += ["--kaito-config-file", config_file]
+    if adapters_dir:
+        args += ["--kaito-adapters-dir", adapters_dir]
+    return args
+
+
+def engine_env(ws: Workspace, md: ModelMetadata, plan: ParallelPlan) -> list[dict]:
+    """Mesh + rendezvous env for the engine pod (replaces the Ray
+    leader/worker shell logic of buildMultiNodeRayCommand)."""
+    mesh = plan.mesh
+    env = [
+        {"name": "KAITO_MESH_SPEC", "value": str(mesh)},
+        {"name": "KAITO_TENSOR_PARALLEL", "value": str(mesh.size("tensor"))},
+        {"name": "KAITO_DATA_PARALLEL", "value": str(mesh.size("data"))},
+        {"name": "KAITO_PIPELINE_PARALLEL", "value": str(mesh.size("pipeline"))},
+        {"name": "KAITO_COORDINATOR",
+         "value": coordinator_address(ws.metadata.name, ws.metadata.namespace)},
+        {"name": "KAITO_TPU_TOPOLOGY", "value": plan.topology},
+    ]
+    if md.download_auth_required:
+        env.append({"name": "HF_TOKEN", "valueFrom": {"secretKeyRef": {
+            "name": f"{ws.metadata.name}-hf-token", "key": "token",
+            "optional": True}}})
+    return env
+
+
+def _probes(num_hosts: int, benchmark: bool) -> dict:
+    """Probe set (reference: preset_inferences.go:316-441): startup probe
+    doubles as the self-benchmark on the leader; distributed pods use
+    the coordinator-health exec probe instead of HTTP."""
+    probes: dict = {
+        "readinessProbe": {
+            "httpGet": {"path": "/health", "port": PORT},
+            "periodSeconds": 10,
+        },
+        "livenessProbe": {
+            "httpGet": {"path": "/health", "port": PORT},
+            "periodSeconds": 30, "failureThreshold": 6,
+        },
+    }
+    if benchmark:
+        probes["startupProbe"] = {
+            "exec": {"command": [
+                "python", "-m", "kaito_tpu.runtime.benchmark_probe"]},
+            "failureThreshold": 60, "periodSeconds": 30,
+            "timeoutSeconds": 600,
+        }
+    else:
+        probes["startupProbe"] = {
+            "httpGet": {"path": "/health", "port": PORT},
+            "failureThreshold": 120, "periodSeconds": 10,
+        }
+    if num_hosts > 1:
+        # workers have no HTTP server; health == coordinator liveness
+        probes["livenessProbe"] = {
+            "exec": {"command": [
+                "python", "-m", "kaito_tpu.runtime.health",
+                "--role", "auto"]},
+            "periodSeconds": 30, "failureThreshold": 6,
+        }
+    return probes
+
+
+def generate_inference_workload(
+    ws: Workspace,
+    md: ModelMetadata,
+    plan: ParallelPlan,
+    node_selector: dict,
+    *,
+    image: str = DEFAULT_IMAGE,
+    benchmark: bool = True,
+) -> list:
+    """Render Service + headless Service + StatefulSet for a workspace."""
+    name = ws.metadata.name
+    ns = ws.metadata.namespace
+    labels = {LABEL_WORKSPACE_NAME: name}
+    num_hosts = plan.num_hosts
+
+    cmd = build_engine_command(
+        ws, md, plan,
+        config_file=(f"/mnt/config/inference_config.yaml"
+                     if ws.inference and ws.inference.config else ""),
+        adapters_dir="/mnt/adapters" if ws.inference and ws.inference.adapters else "")
+
+    volumes: list[dict] = [{"name": "shm", "emptyDir": {"medium": "Memory"}}]
+    mounts = [{"name": "shm", "mountPath": "/dev/shm"}]
+    if ws.inference and ws.inference.config:
+        volumes.append({"name": "config", "configMap": {"name": ws.inference.config}})
+        mounts.append({"name": "config", "mountPath": "/mnt/config"})
+
+    init_containers = []
+    if ws.inference:
+        for a in ws.inference.adapters:
+            # adapter puller (reference: pkg/workspace/image/puller.go via ORAS)
+            volumes.append({"name": f"adapter-{a.name}", "emptyDir": {}})
+            mounts.append({"name": f"adapter-{a.name}",
+                           "mountPath": f"/mnt/adapters/{a.name}"})
+            init_containers.append({
+                "name": f"pull-adapter-{a.name}",
+                "image": a.source_image,
+                "command": ["sh", "-c",
+                            f"cp -r /data/* /mnt/adapters/{a.name}/ 2>/dev/null || "
+                            f"oras pull {shlex.quote(a.source_image)} "
+                            f"-o /mnt/adapters/{a.name}"],
+                "volumeMounts": [{"name": f"adapter-{a.name}",
+                                  "mountPath": f"/mnt/adapters/{a.name}"}],
+            })
+
+    container = {
+        "name": "engine",
+        "image": image,
+        "command": cmd,
+        "env": engine_env(ws, md, plan),
+        "ports": [{"containerPort": PORT}],
+        "resources": {
+            "requests": {"google.com/tpu": str(plan.chip.chips_per_host)},
+            "limits": {"google.com/tpu": str(plan.chip.chips_per_host)},
+        },
+        "volumeMounts": mounts,
+        **_probes(num_hosts, benchmark),
+    }
+
+    svc = generate_service(name, ns, labels, labels=labels)
+    headless = generate_headless_service(name, ns, labels, labels=labels)
+    ss = generate_statefulset(
+        name, ns, replicas=num_hosts, labels=labels,
+        node_selector=node_selector, containers=[container],
+        init_containers=init_containers or None, volumes=volumes)
+    return [svc, headless, ss]

@@ -1,0 +1,114 @@
+"""Karpenter provisioner for GKE TPU slices.
+
+The TPU-native re-design of ``pkg/nodeprovision/karpenter``
+(provisioner.go:311/:460, nodepool.go:96): one ``karpenter.sh/v1
+NodePool`` per workspace with TPU requirements —
+``cloud.google.com/gke-tpu-accelerator`` + ``gke-tpu-topology`` +
+machine type — replicas = number of hosts in the slice, drift budget
+closed (0) by default and opened to 1 by the drift controller.
+"""
+
+from __future__ import annotations
+
+from kaito_tpu.api.meta import ObjectMeta
+from kaito_tpu.controllers.objects import Unstructured, is_node_ready
+from kaito_tpu.controllers.runtime import Store
+from kaito_tpu.provision.provisioner import ProvisionRequest
+from kaito_tpu.sku.catalog import (
+    LABEL_TPU_ACCELERATOR,
+    LABEL_TPU_MACHINE,
+    LABEL_TPU_TOPOLOGY,
+)
+
+LABEL_OWNER = "kaito-tpu.io/workspace"
+LABEL_SLICE_INDEX = "kaito-tpu.io/slice-index"
+
+
+class KarpenterTPUProvisioner:
+    name = "karpenter"
+
+    def __init__(self, store: Store):
+        self.store = store
+
+    # ------------------------------------------------------------------
+
+    def _pool_name(self, req: ProvisionRequest, idx: int) -> str:
+        return f"{req.owner_name}-slice-{idx}"
+
+    def render_nodepool(self, req: ProvisionRequest, idx: int) -> dict:
+        """The NodePool spec rendered for a real cluster (and stored as
+        Unstructured in-process)."""
+        s = req.slice_spec
+        labels = {
+            LABEL_OWNER: req.owner_name,
+            LABEL_SLICE_INDEX: str(idx),
+            **req.extra_labels,
+        }
+        return {
+            "replicas": s.num_hosts,
+            "disruption": {"budgets": [{"nodes": "0"}]},  # drift closed
+            "template": {
+                "metadata": {"labels": labels},
+                "spec": {
+                    "requirements": [
+                        {"key": LABEL_TPU_ACCELERATOR, "operator": "In",
+                         "values": [s.chip.accelerator_label]},
+                        {"key": LABEL_TPU_TOPOLOGY, "operator": "In",
+                         "values": [s.topology]},
+                        {"key": LABEL_TPU_MACHINE, "operator": "In",
+                         "values": [s.machine_type] if s.machine_type else []},
+                    ],
+                    "taints": [{"key": "google.com/tpu", "value": "present",
+                                "effect": "NoSchedule"}],
+                },
+            },
+        }
+
+    # -- NodeProvisioner -----------------------------------------------
+
+    def provision(self, req: ProvisionRequest) -> None:
+        for idx in range(req.num_slices):
+            name = self._pool_name(req, idx)
+            if self.store.try_get("NodePool", "", name) is None:
+                self.store.create(Unstructured(
+                    "NodePool",
+                    ObjectMeta(name=name, namespace="",
+                               labels={LABEL_OWNER: req.owner_name}),
+                    spec=self.render_nodepool(req, idx)))
+
+    def ensure_ready(self, req: ProvisionRequest) -> tuple[bool, list[str]]:
+        ready_nodes: list[str] = []
+        all_ready = True
+        for idx in range(req.num_slices):
+            name = self._pool_name(req, idx)
+            pool = self.store.try_get("NodePool", "", name)
+            if pool is None:
+                return False, []
+            nodes = self.store.list("Node", labels={
+                LABEL_OWNER: req.owner_name, LABEL_SLICE_INDEX: str(idx)})
+            ready = [n for n in nodes if is_node_ready(n)]
+            want = req.slice_spec.num_hosts
+            if len(ready) < want:
+                all_ready = False
+            ready_nodes.extend(n.metadata.name for n in ready)
+        return all_ready, sorted(ready_nodes)
+
+    def deprovision(self, req: ProvisionRequest) -> None:
+        for pool in self.store.list("NodePool",
+                                    labels={LABEL_OWNER: req.owner_name}):
+            self.store.delete("NodePool", "", pool.metadata.name)
+
+    def node_selector(self, req: ProvisionRequest) -> dict[str, str]:
+        sel = dict(req.slice_spec.node_selector())
+        sel[LABEL_OWNER] = req.owner_name
+        return sel
+
+    def set_drift_budget(self, req: ProvisionRequest, allow: bool) -> None:
+        for pool in self.store.list("NodePool",
+                                    labels={LABEL_OWNER: req.owner_name}):
+            def mutate(p, allow=allow):
+                p.spec["disruption"]["budgets"] = [
+                    {"nodes": "1" if allow else "0"}]
+            from kaito_tpu.controllers.runtime import update_with_retry
+
+            update_with_retry(self.store, "NodePool", "", pool.metadata.name, mutate)

@@ -1,0 +1,119 @@
+import pytest
+
+from kaito_tpu.api import (
+    InferenceSet,
+    InferenceSetSpec,
+    ModelMirror,
+    MultiRoleInference,
+    ObjectMeta,
+    RAGEngine,
+    RAGEngineSpec,
+    ResourceSpec,
+    InferenceSpec,
+    TuningSpec,
+    Workspace,
+)
+from kaito_tpu.api.multiroleinference import MultiRoleInferenceSpec, MRIModelSpec, RoleSpec
+from kaito_tpu.api.ragengine import EmbeddingSpec, InferenceServiceSpec, LocalEmbedding
+from kaito_tpu.api.workspace import AdapterSpec, TuningInput, TuningOutput
+
+
+def _ws(**kw):
+    return Workspace(ObjectMeta(name="ws"), **kw)
+
+
+def test_workspace_requires_inference_or_tuning():
+    ws = _ws()
+    assert any("one of inference or tuning" in e for e in ws.validate())
+
+
+def test_workspace_valid_inference():
+    ws = _ws(resource=ResourceSpec(instance_type="ct5lp-hightpu-4t"),
+             inference=InferenceSpec(preset="phi-4-mini-instruct"))
+    ws.default()
+    assert ws.validate() == []
+
+
+def test_workspace_bad_preset_and_topology():
+    ws = _ws(resource=ResourceSpec(tpu_topology="4xx4"),
+             inference=InferenceSpec(preset="not-a-preset"))
+    errs = ws.validate()
+    assert any("tpuTopology" in e for e in errs)
+    assert any("not a known preset" in e for e in errs)
+
+
+def test_workspace_hf_id_preset_allowed():
+    ws = _ws(inference=InferenceSpec(preset="someorg/some-model"))
+    assert ws.validate() == []
+
+
+def test_workspace_unknown_instance_type_needs_selector():
+    ws = _ws(resource=ResourceSpec(instance_type="n2-standard-4"),
+             inference=InferenceSpec(preset="phi-4"))
+    assert any("not a known TPU machine type" in e for e in ws.validate())
+    ws2 = _ws(resource=ResourceSpec(instance_type="n2-standard-4",
+                                    label_selector={"pool": "mine"}),
+              inference=InferenceSpec(preset="phi-4"))
+    assert ws2.validate() == []
+
+
+def test_workspace_adapter_validation():
+    ws = _ws(inference=InferenceSpec(
+        preset="phi-4",
+        adapters=[AdapterSpec(name="a", source_image="img", strength=1.5),
+                  AdapterSpec(name="a", source_image="img")]))
+    errs = ws.validate()
+    assert any("strength" in e for e in errs)
+    assert any("duplicate adapter" in e for e in errs)
+
+
+def test_workspace_tuning_validation():
+    ws = _ws(tuning=TuningSpec(preset="phi-4", method="bad",
+                               input=TuningInput(), output=TuningOutput()))
+    errs = ws.validate()
+    assert any("method" in e for e in errs)
+    assert any("tuning.input" in e for e in errs)
+    assert any("tuning.output" in e for e in errs)
+
+    ok = _ws(tuning=TuningSpec(
+        preset="phi-4", method="qlora",
+        input=TuningInput(urls=["https://x/data.jsonl"]),
+        output=TuningOutput(image="reg/out:v1")))
+    assert ok.validate() == []
+
+
+def test_inferenceset_validation():
+    s = InferenceSet(ObjectMeta(name="is"), InferenceSetSpec(replicas=-1))
+    s.default()
+    assert s.spec.replicas == 0
+    s.spec.template.inference.preset = "phi-4"
+    s.spec.update_strategy = "Nope"
+    errs = s.validate()
+    assert any("updateStrategy" in e for e in errs)
+
+
+def test_ragengine_validation():
+    r = RAGEngine(ObjectMeta(name="rag"), RAGEngineSpec())
+    errs = r.validate()
+    assert any("embedding.local or embedding.remote" in e for e in errs)
+    assert any("inferenceService.url" in e for e in errs)
+
+    r2 = RAGEngine(ObjectMeta(name="rag"), RAGEngineSpec(
+        embedding=EmbeddingSpec(local=LocalEmbedding(model_id="bge-small")),
+        inference_service=InferenceServiceSpec(url="http://ws:5000")))
+    assert r2.validate() == []
+
+
+def test_mri_validation():
+    m = MultiRoleInference(ObjectMeta(name="pd"), MultiRoleInferenceSpec(
+        model=MRIModelSpec(name="llama-3.1-8b-instruct"),
+        roles=[RoleSpec(type="prefill"), RoleSpec(type="decode")]))
+    assert m.validate() == []
+    bad = MultiRoleInference(ObjectMeta(name="pd"), MultiRoleInferenceSpec(
+        model=MRIModelSpec(name="x"), roles=[RoleSpec(type="decode")]))
+    assert bad.validate()
+
+
+def test_modelmirror_validation():
+    mm = ModelMirror(ObjectMeta(name="m"))
+    assert any("modelID" in e for e in mm.validate())

@@ -1,0 +1,191 @@
+"""Workspace controller end-to-end against the fake cloud (the
+simulation backend the reference lacks: its multi-node behavior is only
+string-asserted, SURVEY.md §4)."""
+
+import pytest
+
+from kaito_tpu.api import InferenceSpec, ObjectMeta, ResourceSpec, TuningSpec, Workspace
+from kaito_tpu.api.meta import condition_true
+from kaito_tpu.api.workspace import (
+    ANNOTATION_UPGRADE_TO,
+    COND_INFERENCE_READY,
+    COND_NODE_CLAIM_READY,
+    COND_RESOURCE_READY,
+    COND_WORKSPACE_SUCCEEDED,
+    TuningInput,
+    TuningOutput,
+)
+from kaito_tpu.controllers.runtime import ConflictError, NotFoundError, Store
+from kaito_tpu.controllers.workspace import WorkspaceReconciler
+from kaito_tpu.provision import FakeCloud, KarpenterTPUProvisioner
+
+
+def _env():
+    store = Store()
+    cloud = FakeCloud(store)
+    rec = WorkspaceReconciler(store, KarpenterTPUProvisioner(store))
+    return store, cloud, rec
+
+
+def _drive(store, cloud, rec, ws_name, ticks=6):
+    for _ in range(ticks):
+        rec.reconcile_key("default", ws_name)
+        cloud.tick()
+    return store.get("Workspace", "default", ws_name)
+
+
+def test_store_crud_and_conflicts():
+    store = Store()
+    ws = Workspace(ObjectMeta(name="a"), inference=InferenceSpec(preset="phi-4"))
+    stored = store.create(ws)
+    stale = store.get("Workspace", "default", "a")
+    fresh = store.get("Workspace", "default", "a")
+    fresh.resource.count = 2
+    store.update(fresh)
+    stale.resource.count = 3
+    with pytest.raises(ConflictError):
+        store.update(stale)
+    with pytest.raises(NotFoundError):
+        store.get("Workspace", "default", "nope")
+
+
+def test_single_chip_workspace_reaches_ready():
+    store, cloud, rec = _env()
+    ws = Workspace(
+        ObjectMeta(name="phi"),
+        resource=ResourceSpec(instance_type="ct5lp-hightpu-1t"),
+        inference=InferenceSpec(preset="phi-4-mini-instruct"))
+    store.create(ws)
+    ws = _drive(store, cloud, rec, "phi")
+    assert condition_true(ws.status.conditions, COND_RESOURCE_READY)
+    assert condition_true(ws.status.conditions, COND_INFERENCE_READY)
+    assert condition_true(ws.status.conditions, COND_WORKSPACE_SUCCEEDED)
+    # workload objects exist
+    ss = store.get("StatefulSet", "default", "phi")
+    assert ss.spec["replicas"] == 1
+    svc = store.get("Service", "default", "phi")
+    assert svc.spec["ports"][0]["port"] == 5000
+    store.get("Service", "default", "phi-headless")
+
+
+def test_llama70b_multihost_provisioning():
+    """North-star shape: 70B on v5e → 4x4 slice → 2 hosts, tp=16 cmd."""
+    store, cloud, rec = _env()
+    ws = Workspace(
+        ObjectMeta(name="llama70"),
+        resource=ResourceSpec(instance_type="ct5lp-hightpu-8t"),
+        inference=InferenceSpec(preset="llama-3.3-70b-instruct"))
+    store.create(ws)
+    ws = _drive(store, cloud, rec, "llama70", ticks=8)
+    assert ws.status.target_node_count == 2
+    assert len(ws.status.worker_nodes) == 2
+    ss = store.get("StatefulSet", "default", "llama70")
+    assert ss.spec["replicas"] == 2
+    env = {e["name"]: e.get("value", "") for e in
+           ss.spec["template"]["spec"]["containers"][0]["env"]}
+    assert env["KAITO_TENSOR_PARALLEL"] == "16"
+    assert env["KAITO_TPU_TOPOLOGY"] == "4x4"
+    assert "llama70-0.llama70-headless.default" in env["KAITO_COORDINATOR"]
+    pool = store.get("NodePool", "", "llama70-slice-0")
+    reqs = {r["key"]: r["values"] for r in
+            pool.spec["template"]["spec"]["requirements"] if r["values"]}
+    assert reqs["cloud.google.com/gke-tpu-accelerator"] == ["tpu-v5-lite-podslice"]
+    assert reqs["cloud.google.com/gke-tpu-topology"] == ["4x4"]
+
+
+def test_provisioning_gate_blocks_until_nodes():
+    store, cloud, rec = _env()
+    cloud.provision_delay_ticks = 3
+    ws = Workspace(
+        ObjectMeta(name="slow"),
+        resource=ResourceSpec(instance_type="ct5lp-hightpu-1t"),
+        inference=InferenceSpec(preset="phi-4-mini-instruct"))
+    store.create(ws)
+    rec.reconcile_key("default", "slow")
+    cloud.tick()
+    ws1 = store.get("Workspace", "default", "slow")
+    assert not condition_true(ws1.status.conditions, COND_NODE_CLAIM_READY)
+    assert store.try_get("StatefulSet", "default", "slow") is None
+    ws2 = _drive(store, cloud, rec, "slow", ticks=6)
+    assert condition_true(ws2.status.conditions, COND_INFERENCE_READY)
+
+
+def test_invalid_workspace_gets_condition_not_exception():
+    store, cloud, rec = _env()
+    ws = Workspace(ObjectMeta(name="bad"),
+                   inference=InferenceSpec(preset="no-such-preset"))
+    store.create(ws)
+    ws = _drive(store, cloud, rec, "bad", ticks=2)
+    cond = [c for c in ws.status.conditions if c.type == COND_RESOURCE_READY][0]
+    assert cond.status == "False"
+    assert "preset" in cond.message
+
+
+def test_tuning_workspace_runs_job():
+    store, cloud, rec = _env()
+    ws = Workspace(
+        ObjectMeta(name="tune"),
+        resource=ResourceSpec(instance_type="ct5lp-hightpu-4t"),
+        tuning=TuningSpec(preset="phi-4-mini-instruct", method="qlora",
+                          input=TuningInput(urls=["https://x/d.jsonl"]),
+                          output=TuningOutput(image="reg/out:v1")))
+    store.create(ws)
+    ws = _drive(store, cloud, rec, "tune", ticks=6)
+    job = store.get("Job", "default", "tune")
+    cmds = [c["command"] for c in job.spec["template"]["spec"]["containers"]]
+    assert any("kaito_tpu.tuning.cli" in " ".join(c) for c in cmds)
+    assert any("oras push" in " ".join(c) for c in cmds)  # pusher sidecar
+    names = [c["name"] for c in job.spec["template"]["spec"]["initContainers"]]
+    assert "data-downloader" in names
+    assert condition_true(ws.status.conditions, COND_WORKSPACE_SUCCEEDED)
+
+
+def test_upgrade_annotation_bumps_image():
+    store, cloud, rec = _env()
+    ws = Workspace(
+        ObjectMeta(name="up"),
+        resource=ResourceSpec(instance_type="ct5lp-hightpu-1t"),
+        inference=InferenceSpec(preset="phi-4-mini-instruct"))
+    store.create(ws)
+    _drive(store, cloud, rec, "up")
+
+    def annotate(o):
+        o.metadata.annotations[ANNOTATION_UPGRADE_TO] = "v9"
+    from kaito_tpu.controllers.runtime import update_with_retry
+
+    update_with_retry(store, "Workspace", "default", "up", annotate)
+    _drive(store, cloud, rec, "up", ticks=2)
+    ss = store.get("StatefulSet", "default", "up")
+    assert ss.spec["template"]["spec"]["containers"][0]["image"].endswith(":v9")
+
+
+def test_delete_workspace_cleans_up():
+    store, cloud, rec = _env()
+    ws = Workspace(
+        ObjectMeta(name="gone"),
+        resource=ResourceSpec(instance_type="ct5lp-hightpu-1t"),
+        inference=InferenceSpec(preset="phi-4-mini-instruct"))
+    store.create(ws)
+    _drive(store, cloud, rec, "gone")
+    store.delete("Workspace", "default", "gone")
+    rec.reconcile_key("default", "gone")
+    cloud.tick()
+    assert store.try_get("Workspace", "default", "gone") is None
+    assert store.try_get("StatefulSet", "default", "gone") is None
+    assert store.list("NodePool") == []
+    # cloud reclaimed the nodes
+    assert store.list("Node") == []
+
+
+def test_controller_revision_history():
+    from kaito_tpu.controllers.runtime import sync_controller_revision
+
+    store = Store()
+    ws = Workspace(ObjectMeta(name="r"), inference=InferenceSpec(preset="phi-4"))
+    store.create(ws)
+    r1 = sync_controller_revision(store, ws, ws.revision_payload())
+    r2 = sync_controller_revision(store, ws, ws.revision_payload())
+    assert r1.revision == r2.revision  # dedupe on identical spec
+    ws.resource.count = 2
+    r3 = sync_controller_revision(store, ws, ws.revision_payload())
+    assert r3.revision == r1.revision + 1
