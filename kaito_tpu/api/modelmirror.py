@@ -58,6 +58,9 @@ class ModelMirror(KaitoObject):
     def default(self) -> None:
         if not self.spec.mode:
             self.spec.mode = "managed"
+        if (self.spec.mode == "managed" and not self.spec.storage.bucket
+                and not self.spec.storage.storage_class_name):
+            self.spec.storage.storage_class_name = "filestore-rwx"
 
     def validate(self) -> list[str]:
         errs = []

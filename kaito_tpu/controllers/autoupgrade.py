@@ -1,0 +1,94 @@
+"""Auto-upgrade runner.
+
+Parity: ``pkg/controllers/autoupgrade/runner.go:58-300`` — a periodic
+(non-reconciler) runner: inside the InferenceSet's cron maintenance
+window, label one not-yet-upgraded child workspace at a time with the
+upgrade-to-version annotation; the workspace controller then swaps the
+StatefulSet image and the benchmark re-runs.
+"""
+
+from __future__ import annotations
+
+from datetime import datetime, timezone
+from typing import Optional
+
+from kaito_tpu.api.meta import condition_true
+from kaito_tpu.api.workspace import (
+    ANNOTATION_UPGRADE_TO,
+    COND_INFERENCE_READY,
+    LABEL_CREATED_BY_INFERENCESET,
+)
+from kaito_tpu.controllers.runtime import Store, update_with_retry
+
+
+def cron_matches(cron: str, at: datetime) -> bool:
+    """Minimal 5-field cron matcher (minute hour dom month dow)."""
+    fields = cron.split()
+    if len(fields) != 5:
+        raise ValueError(f"invalid cron {cron!r}")
+    values = [at.minute, at.hour, at.day, at.month, at.isoweekday() % 7]
+
+    def match(spec: str, v: int) -> bool:
+        if spec == "*":
+            return True
+        for part in spec.split(","):
+            if part.startswith("*/"):
+                if v % int(part[2:]) == 0:
+                    return True
+            elif "-" in part:
+                lo, hi = part.split("-")
+                if int(lo) <= v <= int(hi):
+                    return True
+            elif part.isdigit() and int(part) == v:
+                return True
+        return False
+
+    return all(match(s, v) for s, v in zip(fields, values))
+
+
+class AutoUpgradeRunner:
+    """Call tick() on an interval (the manager wires this at ~1/min)."""
+
+    def __init__(self, store: Store, target_version: str):
+        self.store = store
+        self.target_version = target_version
+
+    def in_window(self, iset, at: Optional[datetime] = None) -> bool:
+        au = iset.spec.auto_upgrade
+        if not au.enabled or not au.maintenance_window.cron:
+            return False
+        at = at or datetime.now(timezone.utc)
+        # within `duration` minutes after a cron match
+        for back in range(au.maintenance_window.duration_minutes):
+            probe = at.replace(second=0, microsecond=0)
+            probe = probe.fromtimestamp(probe.timestamp() - back * 60, tz=timezone.utc)
+            if cron_matches(au.maintenance_window.cron, probe):
+                return True
+        return False
+
+    def tick(self, at: Optional[datetime] = None) -> Optional[str]:
+        """Upgrade at most one workspace; returns its name if any."""
+        for iset in self.store.list("InferenceSet"):
+            if not self.in_window(iset, at):
+                continue
+            children = self.store.list(
+                "Workspace", iset.metadata.namespace,
+                labels={LABEL_CREATED_BY_INFERENCESET: iset.metadata.name})
+            # one at a time: wait for any in-flight upgrade to go ready
+            in_flight = [c for c in children
+                         if c.metadata.annotations.get(ANNOTATION_UPGRADE_TO)
+                         == self.target_version
+                         and not condition_true(c.status.conditions,
+                                                COND_INFERENCE_READY)]
+            if in_flight:
+                continue
+            for c in children:
+                if c.metadata.annotations.get(ANNOTATION_UPGRADE_TO) != self.target_version:
+                    def annotate(o):
+                        o.metadata.annotations[ANNOTATION_UPGRADE_TO] = \
+                            self.target_version
+                    update_with_retry(self.store, "Workspace",
+                                      c.metadata.namespace, c.metadata.name,
+                                      annotate)
+                    return c.metadata.name
+        return None

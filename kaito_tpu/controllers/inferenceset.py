@@ -1,0 +1,179 @@
+"""InferenceSet reconciler: replica manager over Workspaces.
+
+Parity: ``pkg/inferenceset/inferenceset_controller.go:195-493`` —
+create/delete child Workspaces from the template (deleting not-ready
+replicas first on scale-down), guard with expectations against
+stale-cache over-creation, surface scale-subresource status
+(replicas/readyReplicas/selector) for KEDA/HPA, aggregate per-replica
+benchmark TPM, and install the Gateway API InferencePool + EPP.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from kaito_tpu.api.inferenceset import InferenceSet
+from kaito_tpu.api.meta import Condition, ObjectMeta, condition_true, set_condition
+from kaito_tpu.api.workspace import (
+    COND_INFERENCE_READY,
+    LABEL_CREATED_BY_INFERENCESET,
+    Workspace,
+)
+from kaito_tpu.controllers.objects import Unstructured
+from kaito_tpu.controllers.runtime import (
+    Expectations,
+    Reconciler,
+    Result,
+    Store,
+    update_with_retry,
+)
+from kaito_tpu.controllers.workspace import BENCH_METRIC_PEAK_TPM
+
+logger = logging.getLogger(__name__)
+
+COND_SET_READY = "InferenceSetReady"
+
+
+class InferenceSetReconciler(Reconciler):
+    kind = "InferenceSet"
+
+    def __init__(self, store: Store, gateway_api_enabled: bool = False):
+        super().__init__(store)
+        self.expectations = Expectations()
+        self.gateway_api_enabled = gateway_api_enabled
+        store.watch(self._observe)
+
+    def _observe(self, event: str, kind: str, obj) -> None:
+        if kind != "Workspace":
+            return
+        owner = obj.metadata.labels.get(LABEL_CREATED_BY_INFERENCESET)
+        if not owner:
+            return
+        key = f"{obj.metadata.namespace}/{owner}"
+        if event == "ADDED":
+            self.expectations.creation_observed(key)
+        elif event == "DELETED":
+            self.expectations.deletion_observed(key)
+
+    # ------------------------------------------------------------------
+
+    def _children(self, iset: InferenceSet) -> list[Workspace]:
+        return self.store.list(
+            "Workspace", iset.metadata.namespace,
+            labels={LABEL_CREATED_BY_INFERENCESET: iset.metadata.name})
+
+    def _make_child(self, iset: InferenceSet, index: int) -> Workspace:
+        import copy
+
+        t = iset.spec.template
+        name = f"{iset.metadata.name}-{index}"
+        ws = Workspace(
+            ObjectMeta(
+                name=name, namespace=iset.metadata.namespace,
+                labels={**t.labels,
+                        LABEL_CREATED_BY_INFERENCESET: iset.metadata.name},
+                annotations=dict(t.annotations),
+                owner_references=[{"kind": "InferenceSet",
+                                   "name": iset.metadata.name,
+                                   "uid": iset.metadata.uid}]),
+            resource=copy.deepcopy(t.resource),
+            inference=copy.deepcopy(t.inference))
+        return ws
+
+    def reconcile(self, iset: InferenceSet) -> Result:
+        if iset.metadata.deletion_timestamp:
+            for ws in self._children(iset):
+                self.store.delete("Workspace", ws.metadata.namespace,
+                                  ws.metadata.name)
+            return Result()
+        iset.default()
+        errs = iset.validate()
+        if errs:
+            self._set_cond(iset, COND_SET_READY, "False", "ValidationFailed",
+                           "; ".join(errs))
+            return Result()
+
+        key = f"{iset.metadata.namespace}/{iset.metadata.name}"
+        if not self.expectations.satisfied(key):
+            return Result(requeue_after=1.0)
+
+        children = self._children(iset)
+        want = iset.spec.replicas
+
+        # node-count guard (spec.nodeCountLimit)
+        if iset.spec.node_count_limit:
+            per_replica = max((c.status.target_node_count for c in children),
+                              default=1) or 1
+            max_replicas = iset.spec.node_count_limit // per_replica
+            want = min(want, max(max_replicas, 0))
+
+        if len(children) < want:
+            used = {c.metadata.name for c in children}
+            creating = 0
+            for i in range(want * 2):
+                if len(children) + creating >= want:
+                    break
+                child = self._make_child(iset, i)
+                if child.metadata.name in used:
+                    continue
+                self.expectations.expect_creations(key, 1)
+                self.store.create(child)
+                creating += 1
+        elif len(children) > want:
+            # delete not-ready first (reference: :222-247)
+            def readiness(ws):
+                return condition_true(ws.status.conditions, COND_INFERENCE_READY)
+
+            victims = sorted(children, key=readiness)[: len(children) - want]
+            for v in victims:
+                self.expectations.expect_deletions(key, 1)
+                self.store.delete("Workspace", v.metadata.namespace,
+                                  v.metadata.name)
+
+        children = self._children(iset)
+        ready = [c for c in children
+                 if condition_true(c.status.conditions, COND_INFERENCE_READY)]
+        tpm = sum(c.status.performance.metrics.get(BENCH_METRIC_PEAK_TPM, 0.0)
+                  for c in ready)
+
+        def set_status(o):
+            o.status.replicas = len(children)
+            o.status.ready_replicas = len(ready)
+            o.status.selector = f"{LABEL_CREATED_BY_INFERENCESET}={iset.metadata.name}"
+            o.status.aggregated_peak_tokens_per_minute = tpm
+            set_condition(o.status.conditions, Condition(
+                type=COND_SET_READY,
+                status="True" if len(ready) >= want and want >= 0 else "False",
+                reason="Ready" if len(ready) >= want else "ScalingUp",
+                message=f"{len(ready)}/{want} replicas ready"))
+        update_with_retry(self.store, "InferenceSet", iset.metadata.namespace,
+                          iset.metadata.name, set_status)
+
+        if self.gateway_api_enabled:
+            self._ensure_inference_pool(iset)
+        return Result() if len(ready) >= want else Result(requeue_after=5.0)
+
+    def _ensure_inference_pool(self, iset: InferenceSet) -> None:
+        """Install the Gateway API InferencePool + endpoint picker
+        (reference: ensureGatewayAPIInferenceExtension :493 via Flux
+        HelmRelease; we render the InferencePool object directly)."""
+        name = f"{iset.metadata.name}-pool"
+        if self.store.try_get("InferencePool", iset.metadata.namespace, name):
+            return
+        self.store.create(Unstructured(
+            "InferencePool",
+            ObjectMeta(name=name, namespace=iset.metadata.namespace,
+                       owner_references=[{"kind": "InferenceSet",
+                                          "name": iset.metadata.name}]),
+            spec={
+                "targetPortNumber": 5000,
+                "selector": {LABEL_CREATED_BY_INFERENCESET: iset.metadata.name},
+                "extensionRef": {"name": f"{iset.metadata.name}-epp"},
+            }))
+
+    def _set_cond(self, iset, type_, status, reason, message):
+        def mutate(o):
+            set_condition(o.status.conditions, Condition(
+                type=type_, status=status, reason=reason, message=message))
+        update_with_retry(self.store, "InferenceSet", iset.metadata.namespace,
+                          iset.metadata.name, mutate)

@@ -1,0 +1,104 @@
+"""Controller manager: wires every reconciler behind feature gates.
+
+Parity: ``cmd/workspace/main.go:100-405`` — flag parsing, gate
+validation, provisioner factory, controller wiring, and the run loop.
+In-process it drives watch-triggered reconciliation plus periodic
+resync; against a real cluster the same wiring hangs off informers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import threading
+import time
+from typing import Optional
+
+from kaito_tpu.controllers.drift import DriftReconciler
+from kaito_tpu.controllers.inferenceset import InferenceSetReconciler
+from kaito_tpu.controllers.modelmirror import ModelMirrorReconciler
+from kaito_tpu.controllers.multiroleinference import MultiRoleInferenceReconciler
+from kaito_tpu.controllers.autoupgrade import AutoUpgradeRunner
+from kaito_tpu.controllers.ragengine import RAGEngineReconciler
+from kaito_tpu.controllers.runtime import Store
+from kaito_tpu.controllers.workspace import WorkspaceReconciler
+from kaito_tpu.featuregates import parse_feature_gates
+from kaito_tpu.provision import new_node_provisioner
+
+logger = logging.getLogger(__name__)
+
+
+class Manager:
+    def __init__(self, store: Optional[Store] = None,
+                 node_provisioner: str = "karpenter",
+                 feature_gates: str = "",
+                 base_image_version: str = "latest"):
+        self.store = store or Store()
+        self.gates = parse_feature_gates(feature_gates)
+        self.provisioner = new_node_provisioner(
+            "byo" if self.gates["disableNodeAutoProvisioning"] else node_provisioner,
+            self.store)
+
+        self.workspace = WorkspaceReconciler(self.store, self.provisioner,
+                                             self.gates)
+        self.reconcilers = [self.workspace]
+        if self.gates["enableInferenceSetController"]:
+            self.inferenceset = InferenceSetReconciler(
+                self.store,
+                gateway_api_enabled=self.gates["gatewayAPIInferenceExtension"])
+            self.reconcilers.append(self.inferenceset)
+        if self.gates["enableMultiRoleInferenceController"]:
+            self.mri = MultiRoleInferenceReconciler(self.store)
+            self.reconcilers.append(self.mri)
+        if self.gates["modelMirror"]:
+            self.modelmirror = ModelMirrorReconciler(self.store)
+            self.reconcilers.append(self.modelmirror)
+        self.ragengine = RAGEngineReconciler(self.store)
+        self.reconcilers.append(self.ragengine)
+        self.drift = DriftReconciler(self.store, self.provisioner)
+        self.autoupgrade = (
+            AutoUpgradeRunner(self.store, base_image_version)
+            if self.gates["enableBaseImageAutoUpgrade"] else None)
+
+        self._stop = threading.Event()
+
+    def resync(self) -> None:
+        """One full reconcile pass over every kind."""
+        for rec in self.reconcilers:
+            for obj in self.store.list(rec.kind):
+                try:
+                    rec.reconcile(obj)
+                except Exception:
+                    logger.exception("reconcile %s/%s failed", rec.kind,
+                                     obj.metadata.name)
+        self.drift.reconcile_drift()
+        if self.autoupgrade:
+            self.autoupgrade.tick()
+
+    def run(self, interval: float = 2.0) -> None:
+        logger.info("manager running; gates=%s", self.gates)
+        while not self._stop.is_set():
+            self.resync()
+            self._stop.wait(interval)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="kaito-tpu-manager")
+    ap.add_argument("--node-provisioner", default="karpenter",
+                    choices=["karpenter", "byo"])
+    ap.add_argument("--feature-gates", default="")
+    ap.add_argument("--base-image-version", default="latest")
+    ap.add_argument("--resync-seconds", type=float, default=2.0)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    mgr = Manager(node_provisioner=args.node_provisioner,
+                  feature_gates=args.feature_gates,
+                  base_image_version=args.base_image_version)
+    mgr.run(args.resync_seconds)
+
+
+if __name__ == "__main__":
+    main()

@@ -68,6 +68,15 @@ class FakeCloud:
 
                 update_with_retry(self.store, "StatefulSet",
                                   ss.metadata.namespace, ss.metadata.name, mark)
+        for dep in self.store.list("Deployment"):
+            want = int(dep.spec.get("replicas", 1))
+            if dep.status.get("readyReplicas", 0) < want:
+                def mark(o, want=want):
+                    o.status["readyReplicas"] = want
+                from kaito_tpu.controllers.runtime import update_with_retry
+
+                update_with_retry(self.store, "Deployment",
+                                  dep.metadata.namespace, dep.metadata.name, mark)
         for job in self.store.list("Job"):
             if not job.status.get("succeeded") and not job.status.get("failed"):
                 def mark(o):

@@ -1,0 +1,201 @@
+"""InferenceSet / MRI / drift / auto-upgrade / modelmirror / ragengine
+controller behavior on the fake cloud."""
+
+from datetime import datetime, timezone
+
+import pytest
+
+from kaito_tpu.api import (
+    InferenceSet,
+    InferenceSetSpec,
+    ModelMirror,
+    MultiRoleInference,
+    ObjectMeta,
+    RAGEngine,
+    RAGEngineSpec,
+    ResourceSpec,
+    InferenceSpec,
+)
+from kaito_tpu.api.inferenceset import AutoUpgradePolicy, MaintenanceWindow, WorkspaceTemplate
+from kaito_tpu.api.meta import condition_true
+from kaito_tpu.api.modelmirror import PHASE_READY, ModelMirrorSpec, MirrorSource
+from kaito_tpu.api.multiroleinference import MRIModelSpec, MultiRoleInferenceSpec, RoleSpec
+from kaito_tpu.api.ragengine import EmbeddingSpec, InferenceServiceSpec, LocalEmbedding
+from kaito_tpu.api.workspace import ANNOTATION_UPGRADE_TO, COND_INFERENCE_READY
+from kaito_tpu.controllers.manager import Manager
+from kaito_tpu.featuregates import parse_feature_gates
+from kaito_tpu.provision import FakeCloud
+
+
+def _mgr(gates="enableMultiRoleInferenceController=true,modelMirror=true,"
+               "gatewayAPIInferenceExtension=true"):
+    mgr = Manager(feature_gates=gates)
+    cloud = FakeCloud(mgr.store)
+    return mgr, cloud
+
+
+def _drive(mgr, cloud, n=8):
+    for _ in range(n):
+        mgr.resync()
+        cloud.tick()
+
+
+def _small_template():
+    return WorkspaceTemplate(
+        resource=ResourceSpec(instance_type="ct5lp-hightpu-1t"),
+        inference=InferenceSpec(preset="phi-4-mini-instruct"))
+
+
+def test_feature_gate_parsing():
+    g = parse_feature_gates("modelMirror=true, pallasAttention=false")
+    assert g["modelMirror"] and not g["pallasAttention"]
+    with pytest.raises(ValueError):
+        parse_feature_gates("nope=true")
+    with pytest.raises(ValueError):
+        parse_feature_gates("modelMirror=maybe")
+
+
+def test_inferenceset_scales_up_and_down():
+    mgr, cloud = _mgr()
+    iset = InferenceSet(ObjectMeta(name="fleet"),
+                        InferenceSetSpec(replicas=3, template=_small_template()))
+    mgr.store.create(iset)
+    _drive(mgr, cloud, 10)
+    live = mgr.store.get("InferenceSet", "default", "fleet")
+    assert live.status.replicas == 3
+    assert live.status.ready_replicas == 3
+    assert live.status.selector
+    # gateway infra installed
+    assert mgr.store.try_get("InferencePool", "default", "fleet-pool")
+
+    def scale(o):
+        o.spec.replicas = 1
+    from kaito_tpu.controllers.runtime import update_with_retry
+
+    update_with_retry(mgr.store, "InferenceSet", "default", "fleet", scale)
+    _drive(mgr, cloud, 10)
+    live = mgr.store.get("InferenceSet", "default", "fleet")
+    assert live.status.replicas == 1
+    assert len(mgr.store.list("Workspace", "default")) == 1
+
+
+def test_mri_creates_role_sets_with_pd_config():
+    mgr, cloud = _mgr()
+    mri = MultiRoleInference(
+        ObjectMeta(name="pd"),
+        MultiRoleInferenceSpec(
+            model=MRIModelSpec(name="phi-4-mini-instruct"),
+            roles=[RoleSpec(type="prefill", replicas=1,
+                            instance_type="ct5lp-hightpu-1t"),
+                   RoleSpec(type="decode", replicas=2,
+                            instance_type="ct5lp-hightpu-1t")]))
+    mgr.store.create(mri)
+    _drive(mgr, cloud, 12)
+    pre = mgr.store.get("InferenceSet", "default", "pd-prefill")
+    dec = mgr.store.get("InferenceSet", "default", "pd-decode")
+    assert pre.spec.replicas == 1 and dec.spec.replicas == 2
+    assert pre.metadata.labels["kaito-tpu.io/inference-role"] == "prefill"
+    pool = mgr.store.get("InferencePool", "default", "pd-pool")
+    types = [p["type"] for p in pool.spec["eppPluginsConfig"]["plugins"]]
+    assert "pd-filter" in types and "kv-locality-scorer" in types
+    live = mgr.store.get("MultiRoleInference", "default", "pd")
+    assert live.status.role_ready == {"prefill": True, "decode": True}
+
+
+def test_modelmirror_lifecycle():
+    mgr, cloud = _mgr()
+    mm = ModelMirror(ObjectMeta(name="llama-cache", namespace=""),
+                     ModelMirrorSpec(source=MirrorSource(model_id="meta/l")))
+    mm.spec.storage.bucket = "weights-bucket"
+    mgr.store.create(mm)
+    mgr.resync()          # creates download job, phase Downloading
+    live = mgr.store.get("ModelMirror", "", "llama-cache")
+    assert live.status.phase in ("Downloading", "Pending")
+    cloud.tick()          # fake kubelet: job succeeds
+    mgr.resync()
+    live = mgr.store.get("ModelMirror", "", "llama-cache")
+    assert live.status.phase == PHASE_READY
+
+
+def test_ragengine_deploys_service():
+    mgr, cloud = _mgr()
+    rag = RAGEngine(ObjectMeta(name="rag"), RAGEngineSpec(
+        embedding=EmbeddingSpec(local=LocalEmbedding(model_id="bge-small")),
+        inference_service=InferenceServiceSpec(url="http://phi:5000/v1")))
+    mgr.store.create(rag)
+    _drive(mgr, cloud, 4)
+    dep = mgr.store.get("Deployment", "default", "rag")
+    env = {e["name"]: e["value"] for e in
+           dep.spec["template"]["spec"]["containers"][0]["env"]}
+    assert env["LLM_INFERENCE_URL"] == "http://phi:5000/v1"
+    assert env["EMBEDDING_MODEL_ID"] == "bge-small"
+    # local embedding rides one TPU chip
+    res = dep.spec["template"]["spec"]["containers"][0]["resources"]
+    assert res["limits"]["google.com/tpu"] == "1"
+    live = mgr.store.get("RAGEngine", "default", "rag")
+    from kaito_tpu.api.ragengine import COND_RAG_SERVICE_READY
+
+    assert condition_true(live.status.conditions, COND_RAG_SERVICE_READY)
+
+
+def test_drift_opens_one_budget_with_ready_sibling():
+    mgr, cloud = _mgr()
+    iset = InferenceSet(ObjectMeta(name="fleet"),
+                        InferenceSetSpec(replicas=2, template=_small_template()))
+    mgr.store.create(iset)
+    _drive(mgr, cloud, 10)
+    nodes = mgr.store.list("Node")
+    assert nodes
+    cloud.mark_drifted(nodes[0].metadata.name)
+    mgr.resync()
+    owner = nodes[0].metadata.labels["kaito-tpu.io/workspace"]
+    pools = mgr.store.list("NodePool")
+    budgets = {p.metadata.name: p.spec["disruption"]["budgets"][0]["nodes"]
+               for p in pools}
+    opened = [n for n, b in budgets.items() if b == "1"]
+    assert len(opened) == 1
+    assert opened[0].startswith(owner)
+
+
+def test_autoupgrade_window_and_one_at_a_time():
+    from kaito_tpu.controllers.autoupgrade import AutoUpgradeRunner, cron_matches
+
+    assert cron_matches("0 3 * * *", datetime(2026, 7, 28, 3, 0, tzinfo=timezone.utc))
+    assert not cron_matches("0 3 * * *", datetime(2026, 7, 28, 4, 0, tzinfo=timezone.utc))
+
+    mgr, cloud = _mgr()
+    iset = InferenceSet(
+        ObjectMeta(name="fleet"),
+        InferenceSetSpec(replicas=2, template=_small_template(),
+                         auto_upgrade=AutoUpgradePolicy(
+                             enabled=True,
+                             maintenance_window=MaintenanceWindow(cron="0 3 * * *"))))
+    mgr.store.create(iset)
+    _drive(mgr, cloud, 10)
+
+    runner = AutoUpgradeRunner(mgr.store, "v2")
+    inside = datetime(2026, 7, 28, 3, 10, tzinfo=timezone.utc)
+    outside = datetime(2026, 7, 28, 12, 0, tzinfo=timezone.utc)
+    assert runner.tick(at=outside) is None
+    first = runner.tick(at=inside)
+    assert first is not None
+    # in-flight not ready yet -> no second upgrade
+    ws = mgr.store.get("Workspace", "default", first)
+    assert ws.metadata.annotations[ANNOTATION_UPGRADE_TO] == "v2"
+
+    def unready(o):
+        for c in o.status.conditions:
+            if c.type == COND_INFERENCE_READY:
+                c.status = "False"
+    from kaito_tpu.controllers.runtime import update_with_retry
+
+    update_with_retry(mgr.store, "Workspace", "default", first, unready)
+    assert runner.tick(at=inside) is None
+    # once ready again, the next one upgrades
+    def ready(o):
+        for c in o.status.conditions:
+            if c.type == COND_INFERENCE_READY:
+                c.status = "True"
+    update_with_retry(mgr.store, "Workspace", "default", first, ready)
+    second = runner.tick(at=inside)
+    assert second is not None and second != first
