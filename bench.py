@@ -12,7 +12,9 @@ vs_baseline anchors to the repo north star of 2,000 tokens/s/chip
 
 import argparse
 import json
+import os
 import sys
+import threading
 import time
 
 import numpy as np
@@ -20,6 +22,24 @@ import numpy as np
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
+
+
+def _watchdog(deadline_s: float):
+    """A wedged accelerator must not hang the driver: emit a diagnostic
+    JSON line and die if the bench exceeds its deadline."""
+
+    def fire():
+        log(f"bench watchdog fired after {deadline_s}s")
+        print(json.dumps({
+            "metric": "decode_throughput", "value": 0.0,
+            "unit": "tokens/s/chip", "vs_baseline": 0.0,
+            "error": f"bench exceeded {deadline_s}s deadline (device hang?)",
+        }), flush=True)
+        os._exit(2)
+
+    t = threading.Timer(deadline_s, fire)
+    t.daemon = True
+    t.start()
 
 
 def main():
@@ -30,7 +50,9 @@ def main():
     ap.add_argument("--decode-steps", type=int, default=128)
     ap.add_argument("--repeats", type=int, default=3)
     ap.add_argument("--attn-impl", default="", choices=["", "jax", "pallas"])
+    ap.add_argument("--deadline", type=float, default=1500.0)
     args = ap.parse_args()
+    _watchdog(args.deadline)
 
     import jax
     import jax.numpy as jnp

@@ -1,0 +1,53 @@
+"""Serving-side LoRA adapter loading.
+
+Counterpart of the reference wrapper's ``--kaito-adapters-dir``
+discovery + vLLM LoRARequest plumbing (``inference_api.py:417``): at
+startup the engine scans the adapter directory, loads our adapter
+artifacts (kaito_tpu.tuning.lora format), and applies them — merged
+into the base weights for zero-overhead serving.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+
+def discover_adapters(adapters_dir: str) -> dict[str, str]:
+    """Find adapters: subdirectories holding an adapter config."""
+    found: dict[str, str] = {}
+    if not adapters_dir or not os.path.isdir(adapters_dir):
+        return found
+    for name in sorted(os.listdir(adapters_dir)):
+        path = os.path.join(adapters_dir, name)
+        if os.path.isdir(path) and (
+            os.path.exists(os.path.join(path, "adapter_config.json"))
+            or os.path.exists(os.path.join(path, "adapter.msgpack"))
+        ):
+            found[name] = path
+    return found
+
+
+def apply_adapters_to_params(model, params, adapters_dir: str) -> dict:
+    """Load every adapter in the dir and merge into the base weights.
+    Multiple adapters merge additively (strength folded at tune time)."""
+    from kaito_tpu.tuning.lora import (
+        LoraConfig,
+        apply_adapter,
+        load_adapter,
+        merge_lora,
+    )
+
+    for name, path in discover_adapters(adapters_dir).items():
+        try:
+            adapter, cfg, base = load_adapter(path)
+        except Exception:
+            logger.exception("skipping unreadable adapter %s", name)
+            continue
+        logger.info("loading adapter %s (base %s, r=%d)", name, base, cfg.r)
+        params = apply_adapter(params, adapter)
+        model.lora_scaling = cfg.scaling
+        params = merge_lora(model, params)
+    return params

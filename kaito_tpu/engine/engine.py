@@ -149,6 +149,11 @@ class InferenceEngine:
                     2 * self.cache.k.nbytes / 2**30)
 
         self.params = params if params is not None else self._init_params()
+        if cfg.adapters_dir:
+            from kaito_tpu.engine.adapters import apply_adapters_to_params
+
+            self.params = apply_adapters_to_params(self.model, self.params,
+                                                   cfg.adapters_dir)
         self.allocator = PageAllocator(num_pages)
         S = cfg.max_num_seqs
         self.slots = [_Slot() for _ in range(S)]

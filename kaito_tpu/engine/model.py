@@ -64,6 +64,7 @@ class TransformerLM:
         self.arch = arch
         self.dtype = dtype
         self.attn_impl = attn_impl  # "jax" | "pallas" (paged decode)
+        self.lora_scaling = 0.0     # set by the tuner when lora keys exist
         self.groups = _layer_groups(arch)
         self.vocab_padded = -(-arch.vocab_size // VOCAB_ALIGN) * VOCAB_ALIGN
         # rope tables are concrete constants; computing them lazily inside
@@ -231,9 +232,10 @@ class TransformerLM:
         """
         a = self.arch
         B, T, _ = x.shape
-        q = x @ p["q"]
-        k = x @ p["k"]
-        v = x @ p["v"]
+        ls = self.lora_scaling
+        q = nn.linear(x, p["q"]) + nn.lora_delta(x, p, "q", ls)
+        k = nn.linear(x, p["k"]) + nn.lora_delta(x, p, "k", ls)
+        v = nn.linear(x, p["v"]) + nn.lora_delta(x, p, "v", ls)
         if "q_bias" in p:
             q, k, v = q + p["q_bias"], k + p["k_bias"], v + p["v_bias"]
         q = q.reshape(B, T, a.num_heads, a.head_dim)
@@ -256,7 +258,7 @@ class TransformerLM:
             B, T, E = x.shape
             y = nn.moe_mlp(x.reshape(B * T, E), p, self.arch)
             return y.reshape(B, T, E)
-        return nn.mlp(x, p, self.arch)
+        return nn.mlp(x, p, self.arch, self.lora_scaling)
 
     def _norm(self, x, p, name):
         if self.arch.norm_type == "layernorm":
@@ -299,7 +301,8 @@ class TransformerLM:
                     q[:, 0], ck, cv, page_tables, lengths, scale=self._scale,
                     sliding_window=window, logit_softcap=a.attn_logit_softcap)
             out = out[:, None]
-        attn_out = out.reshape(B, T, a.num_heads * a.head_dim) @ p["o"]
+        o_in = out.reshape(B, T, a.num_heads * a.head_dim)
+        attn_out = nn.linear(o_in, p["o"]) + nn.lora_delta(o_in, p, "o", self.lora_scaling)
         if "o_bias" in p:
             attn_out = attn_out + p["o_bias"]
 
@@ -376,7 +379,8 @@ class TransformerLM:
         out = attn.prefill_attention(
             q, k_new, v_new, scale=self._scale, sliding_window=window,
             logit_softcap=a.attn_logit_softcap, true_len=true_lens)
-        attn_out = out.reshape(B, T, a.num_heads * a.head_dim) @ p["o"]
+        o_in = out.reshape(B, T, a.num_heads * a.head_dim)
+        attn_out = nn.linear(o_in, p["o"]) + nn.lora_delta(o_in, p, "o", self.lora_scaling)
         if "o_bias" in p:
             attn_out = attn_out + p["o_bias"]
         if a.parallel_residual:

@@ -18,6 +18,27 @@ import jax.numpy as jnp
 from kaito_tpu.models.metadata import ModelArch
 
 
+def linear(x: jax.Array, w) -> jax.Array:
+    """Matmul accepting either a plain weight or an int8 QTensor dict
+    ``{"q8": int8[in,out], "scale": f32[out]}`` (per-out-channel
+    symmetric quantization).  Under jit the int8 stays in HBM and the
+    dequant fuses into the dot — the QLoRA memory model.
+    """
+    if isinstance(w, dict) and "q8" in w:
+        return (x @ w["q8"].astype(x.dtype)) * w["scale"].astype(x.dtype)
+    return x @ w
+
+
+def lora_delta(x: jax.Array, p: dict, name: str, scaling: float) -> jax.Array:
+    """Low-rank update ``(x @ A) @ B * (alpha/r)`` when the layer stack
+    carries lora factors for ``name`` (keys set by kaito_tpu.tuning.lora)."""
+    a = p.get(f"{name}_lora_a")
+    if a is None:
+        return 0.0
+    b = p[f"{name}_lora_b"]
+    return ((x @ a) @ b) * scaling
+
+
 def rms_norm(x: jax.Array, scale: jax.Array, eps: float, offset: bool) -> jax.Array:
     dtype = x.dtype
     x32 = x.astype(jnp.float32)
@@ -115,18 +136,19 @@ def activation(x: jax.Array, name: str) -> jax.Array:
     raise ValueError(f"unknown activation {name!r}")
 
 
-def mlp(x: jax.Array, p: dict, arch: ModelArch) -> jax.Array:
+def mlp(x: jax.Array, p: dict, arch: ModelArch, lora_scaling: float = 0.0) -> jax.Array:
     """Gated (SwiGLU/GeGLU) or classic 2-matrix MLP."""
     if arch.gated_mlp:
-        gate = activation(x @ p["gate"], arch.hidden_act)
-        up = x @ p["up"]
+        gate = activation(linear(x, p["gate"]) + lora_delta(x, p, "gate", lora_scaling),
+                          arch.hidden_act)
+        up = linear(x, p["up"]) + lora_delta(x, p, "up", lora_scaling)
         h = gate * up
     else:
-        h = x @ p["up"]
+        h = linear(x, p["up"]) + lora_delta(x, p, "up", lora_scaling)
         if "up_bias" in p:
             h = h + p["up_bias"]
         h = activation(h, arch.hidden_act)
-    out = h @ p["down"]
+    out = linear(h, p["down"]) + lora_delta(h, p, "down", lora_scaling)
     if "down_bias" in p:
         out = out + p["down_bias"]
     return out

@@ -30,21 +30,7 @@ from kaito_tpu.engine.rate_limit import RateLimiter
 logger = logging.getLogger(__name__)
 
 
-def discover_adapters(adapters_dir: str) -> dict[str, str]:
-    """Find LoRA adapters: subdirectories holding an adapter config
-    (reference behavior: ``inference_api.py`` load_lora_adapters scans
-    --kaito-adapters-dir)."""
-    found: dict[str, str] = {}
-    if not adapters_dir or not os.path.isdir(adapters_dir):
-        return found
-    for name in sorted(os.listdir(adapters_dir)):
-        path = os.path.join(adapters_dir, name)
-        if os.path.isdir(path) and (
-            os.path.exists(os.path.join(path, "adapter_config.json"))
-            or os.path.exists(os.path.join(path, "adapter.msgpack"))
-        ):
-            found[name] = path
-    return found
+from kaito_tpu.engine.adapters import discover_adapters  # noqa: E402
 
 
 class ServerState:

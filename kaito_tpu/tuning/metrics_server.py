@@ -1,0 +1,76 @@
+"""Tuning metrics sidecar.
+
+Parity: the reference's training-side metrics server
+(``presets/workspace/tuning/text-generation/metrics_server.py:112``)
+reporting progress on :5000 — ours serves the trainer's metrics file as
+Prometheus text + JSON, plus host utilization.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from kaito_tpu.tuning.trainer import METRICS_FILE, SENTINEL
+
+
+class Handler(BaseHTTPRequestHandler):
+    results_dir = ""
+
+    def log_message(self, *a):
+        pass
+
+    def _read(self) -> dict:
+        try:
+            with open(os.path.join(self.results_dir, METRICS_FILE)) as f:
+                return json.load(f)
+        except Exception:
+            return {}
+
+    def do_GET(self):
+        if self.path == "/health":
+            body = b'{"status": "ok"}'
+            ctype = "application/json"
+        elif self.path == "/metrics":
+            m = self._read()
+            done = os.path.exists(os.path.join(self.results_dir, SENTINEL))
+            lines = [
+                "# TYPE kaito_tuning_step gauge",
+                f"kaito_tuning_step {m.get('step', 0)}",
+                "# TYPE kaito_tuning_loss gauge",
+                f"kaito_tuning_loss {m.get('loss', 0.0)}",
+                "# TYPE kaito_tuning_tokens_per_second gauge",
+                f"kaito_tuning_tokens_per_second {m.get('tokens_per_second', 0.0)}",
+                "# TYPE kaito_tuning_completed gauge",
+                f"kaito_tuning_completed {1 if done else 0}",
+            ]
+            body = ("\n".join(lines) + "\n").encode()
+            ctype = "text/plain; version=0.0.4"
+        elif self.path == "/progress":
+            body = json.dumps(self._read()).encode()
+            ctype = "application/json"
+        else:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=5000)
+    ap.add_argument("--results-dir", required=True)
+    args = ap.parse_args(argv)
+    handler = type("H", (Handler,), {"results_dir": args.results_dir})
+    ThreadingHTTPServer(("0.0.0.0", args.port), handler).serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,47 @@
+"""Weight quantization for QLoRA bases.
+
+The reference reaches for BitsAndBytes 4-bit (``cli.py``
+QuantizationConfig); on TPU the sweet spot is int8 per-out-channel
+symmetric quantization: the MXU has native int8 throughput, XLA fuses
+the dequant into the matmul, and HBM holds half the bytes.  Weights
+become ``{"q8": int8[in,out], "scale": f32[out]}`` leaves that
+``engine.nn.linear`` consumes transparently.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from kaito_tpu.engine.model import TransformerLM
+
+QUANT_TARGETS = ("q", "k", "v", "o", "gate", "up", "down")
+
+
+def quantize_weight(w: jax.Array) -> dict:
+    """Per-out-channel symmetric int8 over the last dim.
+    w: [..., in, out] -> q8 same shape + scale [..., out]."""
+    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2)
+    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale[..., None, :]),
+                 -127, 127).astype(jnp.int8)
+    return {"q8": q, "scale": scale}
+
+
+def dequantize_weight(qt: dict, dtype=jnp.bfloat16) -> jax.Array:
+    return (qt["q8"].astype(jnp.float32) * qt["scale"][..., None, :]).astype(dtype)
+
+
+def quantize_base(model: TransformerLM, params: dict) -> dict:
+    """Quantize the dense projection weights of every layer stack
+    (embeddings, norms, MoE experts stay bf16 in round 1)."""
+    out = dict(params)
+    for g in model.groups:
+        stack = dict(params[g.name])
+        for t in QUANT_TARGETS:
+            w = stack.get(t)
+            if w is None or isinstance(w, dict):
+                continue
+            stack[t] = quantize_weight(w)
+        out[g.name] = stack
+    return out

@@ -1,0 +1,43 @@
+"""Tune → save adapter → serve with it: the full lifecycle the
+reference covers with PEFT outputs + vLLM LoRA loading."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kaito_tpu.engine.config import EngineConfig
+from kaito_tpu.engine.engine import InferenceEngine, SamplingParams
+from kaito_tpu.engine.model import TransformerLM
+from kaito_tpu.models import get_model_by_name
+from kaito_tpu.tuning.lora import LoraConfig, add_lora_params, save_adapter
+
+TINY = get_model_by_name("tiny-llama-test").arch
+
+
+def test_engine_serves_merged_adapter(tmp_path):
+    # craft an adapter with a non-zero delta
+    model = TransformerLM(TINY, dtype=jnp.float32)
+    params = add_lora_params(model, model.init_params(jax.random.PRNGKey(0)),
+                             LoraConfig(r=4), jax.random.PRNGKey(1))
+    params["dense"]["q_lora_b"] = 0.5 * jax.random.normal(
+        jax.random.PRNGKey(2), params["dense"]["q_lora_b"].shape, jnp.float32)
+    adir = tmp_path / "adapters" / "style"
+    save_adapter(str(adir), params, LoraConfig(r=4), "tiny-llama-test")
+
+    cfg = EngineConfig(model="tiny-llama-test", max_model_len=128, page_size=16,
+                       max_num_seqs=2, dtype="float32", kv_dtype="float32",
+                       prefill_buckets=(32,))
+    base_engine = InferenceEngine(cfg)
+    adapted = InferenceEngine(cfg.replace(adapters_dir=str(tmp_path / "adapters")))
+
+    p = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+    base_engine.start(); adapted.start()
+    try:
+        base_out = list(base_engine.submit([5, 6, 7], p).stream())
+        adapted_out = list(adapted.submit([5, 6, 7], p).stream())
+    finally:
+        base_engine.stop(); adapted.stop()
+    # a real delta must change greedy decoding for synthetic weights
+    assert base_out != adapted_out

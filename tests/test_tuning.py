@@ -1,0 +1,145 @@
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kaito_tpu.engine.model import TransformerLM
+from kaito_tpu.models import get_model_by_name
+from kaito_tpu.tuning.lora import (
+    LoraConfig,
+    add_lora_params,
+    apply_adapter,
+    load_adapter,
+    lora_mask,
+    merge_lora,
+    save_adapter,
+)
+from kaito_tpu.tuning.quant import dequantize_weight, quantize_base, quantize_weight
+from kaito_tpu.tuning.trainer import SENTINEL, TrainConfig, Trainer
+
+TINY = get_model_by_name("tiny-llama-test").arch
+
+
+def _write_dataset(tmp_path, n=24):
+    rows = [{"instruction": f"add {i} and {i+1}", "response": str(2 * i + 1)}
+            for i in range(n)]
+    p = tmp_path / "train.jsonl"
+    p.write_text("\n".join(json.dumps(r) for r in rows))
+    return str(tmp_path)
+
+
+def test_lora_zero_init_is_identity():
+    model = TransformerLM(TINY, dtype=jnp.float32)
+    params = model.init_params(jax.random.PRNGKey(0))
+    toks = jnp.asarray(np.random.RandomState(0).randint(0, TINY.vocab_size, (1, 8)))
+    base_logits = model.forward_train(params, toks, remat=False)
+    lparams = add_lora_params(model, params, LoraConfig(r=4), jax.random.PRNGKey(1))
+    lora_logits = model.forward_train(lparams, toks, remat=False)
+    np.testing.assert_allclose(np.asarray(base_logits), np.asarray(lora_logits),
+                               rtol=1e-6)
+
+
+def test_lora_mask_only_marks_lora():
+    model = TransformerLM(TINY, dtype=jnp.float32)
+    params = add_lora_params(model, model.init_params(jax.random.PRNGKey(0)),
+                             LoraConfig(r=4), jax.random.PRNGKey(1))
+    mask = lora_mask(params)
+    flat = jax.tree_util.tree_flatten_with_path(mask)[0]
+    trainable = [p for p, v in flat if v]
+    frozen = [p for p, v in flat if not v]
+    assert trainable and frozen
+    assert all("lora" in jax.tree_util.keystr(p) for p in trainable)
+
+
+def test_merge_lora_matches_runtime_lora():
+    model = TransformerLM(TINY, dtype=jnp.float32)
+    params = add_lora_params(model, model.init_params(jax.random.PRNGKey(0)),
+                             LoraConfig(r=4), jax.random.PRNGKey(1))
+    # give B nonzero values so the delta matters
+    params["dense"]["q_lora_b"] = 0.1 * jax.random.normal(
+        jax.random.PRNGKey(2), params["dense"]["q_lora_b"].shape, jnp.float32)
+    toks = jnp.asarray(np.random.RandomState(0).randint(0, TINY.vocab_size, (1, 8)))
+    live = model.forward_train(params, toks, remat=False)
+
+    merged = merge_lora(model, params)
+    model2 = TransformerLM(TINY, dtype=jnp.float32)  # lora_scaling back to 0
+    out = model2.forward_train(merged, toks, remat=False)
+    np.testing.assert_allclose(np.asarray(live), np.asarray(out),
+                               rtol=5e-4, atol=5e-4)
+    assert "q_lora_a" not in merged["dense"]
+
+
+def test_quantize_roundtrip_error_small():
+    w = jax.random.normal(jax.random.PRNGKey(0), (3, 64, 32), jnp.float32)
+    qt = quantize_weight(w)
+    assert qt["q8"].dtype == jnp.int8
+    back = dequantize_weight(qt, jnp.float32)
+    err = np.abs(np.asarray(back) - np.asarray(w)).max()
+    assert err < np.abs(np.asarray(w)).max() / 100  # ~1/127 relative
+
+
+def test_qlora_forward_close_to_fp():
+    model = TransformerLM(TINY, dtype=jnp.float32)
+    params = model.init_params(jax.random.PRNGKey(0))
+    toks = jnp.asarray(np.random.RandomState(0).randint(0, TINY.vocab_size, (1, 8)))
+    ref = model.forward_train(params, toks, remat=False)
+    qparams = quantize_base(model, params)
+    out = model.forward_train(qparams, toks, remat=False)
+    # int8 per-channel keeps logits close
+    rel = np.abs(np.asarray(out) - np.asarray(ref)).max() / \
+        max(np.abs(np.asarray(ref)).max(), 1e-6)
+    assert rel < 0.08
+
+
+@pytest.mark.parametrize("method", ["lora", "qlora"])
+def test_training_reduces_loss_and_saves_adapter(tmp_path, method):
+    data_dir = _write_dataset(tmp_path)
+    out_dir = str(tmp_path / "out")
+    cfg = TrainConfig(model="tiny-llama-test", method=method,
+                      data_dir=data_dir, output_dir=out_dir,
+                      batch_size=4, max_seq_len=32, num_epochs=4,
+                      learning_rate=5e-3, checkpoint_every=0,
+                      warmup_steps=2)
+    trainer = Trainer(cfg)
+    result = trainer.train()
+    assert result["steps"] > 0
+    assert os.path.exists(os.path.join(out_dir, SENTINEL))
+    adapter_dir = os.path.join(out_dir, "adapter")
+    adapter, lcfg, base = load_adapter(adapter_dir)
+    assert base == "tiny-llama-test"
+    assert any("lora_b" in k for k in adapter)
+    # B should have moved away from zero
+    total = sum(np.abs(v).sum() for k, v in adapter.items() if "lora_b" in k)
+    assert total > 0
+
+
+def test_resume_from_checkpoint(tmp_path):
+    data_dir = _write_dataset(tmp_path)
+    out_dir = str(tmp_path / "out")
+    cfg = TrainConfig(model="tiny-llama-test", method="lora",
+                      data_dir=data_dir, output_dir=out_dir,
+                      batch_size=4, max_seq_len=32, num_epochs=1,
+                      max_steps=4, checkpoint_every=2, warmup_steps=1)
+    Trainer(cfg).train()
+    # second trainer resumes from step 4's checkpoint
+    cfg2 = TrainConfig(**{**cfg.__dict__, "max_steps": 6})
+    t2 = Trainer(cfg2)
+    resumed = t2.restore_latest()
+    assert resumed >= 2
+
+
+def test_adapter_roundtrip_apply(tmp_path):
+    model = TransformerLM(TINY, dtype=jnp.float32)
+    params = add_lora_params(model, model.init_params(jax.random.PRNGKey(0)),
+                             LoraConfig(r=4), jax.random.PRNGKey(1))
+    save_adapter(str(tmp_path / "ad"), params, LoraConfig(r=4), "tiny-llama-test")
+    adapter, _, _ = load_adapter(str(tmp_path / "ad"))
+    base = model.init_params(jax.random.PRNGKey(0))
+    restored = apply_adapter(base, adapter)
+    assert "q_lora_a" in restored["dense"]
+    np.testing.assert_allclose(
+        np.asarray(restored["dense"]["q_lora_a"]),
+        np.asarray(params["dense"]["q_lora_a"]), rtol=1e-6)
