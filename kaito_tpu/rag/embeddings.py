@@ -1,0 +1,147 @@
+"""Embedding backends.
+
+Parity with the reference's embedding layer (``presets/ragengine/
+embedding/``): a local model on accelerator or a remote
+OpenAI-compatible endpoint.  The local path runs a JAX encoder on one
+TPU chip (mean-pooled transformer states — the RAGEngine north-star
+item); a deterministic hashing embedder backs tests and
+accelerator-free environments.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import re
+import urllib.request
+from typing import Optional, Protocol, Sequence
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+class Embedder(Protocol):
+    dim: int
+
+    def embed(self, texts: Sequence[str]) -> np.ndarray: ...
+
+
+class HashingEmbedder:
+    """Deterministic feature-hashing embedder (tokenized character
+    n-grams -> signed buckets, L2-normalized). No model weights, real
+    cosine-similarity semantics — the test/default backend."""
+
+    def __init__(self, dim: int = 384):
+        self.dim = dim
+
+    def _tokens(self, text: str):
+        words = re.findall(r"\w+", text.lower())
+        for w in words:
+            yield w
+        for w in words:
+            for i in range(len(w) - 2):
+                yield w[i:i + 3]
+
+    def embed(self, texts: Sequence[str]) -> np.ndarray:
+        out = np.zeros((len(texts), self.dim), np.float32)
+        for row, text in enumerate(texts):
+            for tok in self._tokens(text):
+                h = int.from_bytes(
+                    hashlib.md5(tok.encode()).digest()[:8], "little")
+                idx = h % self.dim
+                sign = 1.0 if (h >> 63) & 1 == 0 else -1.0
+                out[row, idx] += sign
+        norms = np.linalg.norm(out, axis=1, keepdims=True)
+        return out / np.maximum(norms, 1e-9)
+
+
+class LocalJaxEmbedder:
+    """Mean-pooled transformer embedding on the local accelerator.
+
+    Serves the RAGEngine ``embedding.local`` path; with synthetic
+    weights the embedding is a random-but-fixed projection, which still
+    exercises the full accelerator path end-to-end.
+    """
+
+    def __init__(self, model_id: str, max_len: int = 256):
+        import jax
+        import jax.numpy as jnp
+
+        from kaito_tpu.engine.model import TransformerLM
+        from kaito_tpu.engine.tokenizer import load_tokenizer
+        from kaito_tpu.models.registry import get_model_by_name
+
+        try:
+            md = get_model_by_name(model_id)
+        except KeyError:
+            md = get_model_by_name("tiny-llama-test")
+            logger.warning("embedding model %s unknown; using tiny fallback",
+                           model_id)
+        self._jnp = jnp
+        self.model = TransformerLM(md.arch, dtype=jnp.float32)
+        self.params = jax.jit(self.model.init_params)(jax.random.PRNGKey(0))
+        self.tokenizer = load_tokenizer(md.hf_id, md.arch.vocab_size)
+        self.max_len = max_len
+        self.dim = md.arch.hidden_size
+        self._fwd = jax.jit(self._forward)
+
+    def _forward(self, tokens, mask):
+        jnp = self._jnp
+        x = self.model._embed(self.params, tokens)
+        positions = jnp.broadcast_to(
+            jnp.arange(tokens.shape[1], dtype=jnp.int32), tokens.shape)
+        true_lens = mask.sum(-1).astype(jnp.int32)
+        h, _ = self.model._run_layers(
+            self.params, None, x, "train", positions=positions,
+            page_tables=None, lengths=None, true_lens=true_lens, active=None,
+            remat=False)
+        h = h * mask[..., None]
+        pooled = h.sum(1) / jnp.maximum(mask.sum(-1, keepdims=True), 1.0)
+        return pooled / jnp.maximum(
+            jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9)
+
+    def embed(self, texts: Sequence[str]) -> np.ndarray:
+        jnp = self._jnp
+        B = len(texts)
+        toks = np.zeros((B, self.max_len), np.int32)
+        mask = np.zeros((B, self.max_len), np.float32)
+        for i, t in enumerate(texts):
+            ids = self.tokenizer.encode(t)[: self.max_len]
+            toks[i, : len(ids)] = ids
+            mask[i, : len(ids)] = 1.0
+        out = self._fwd(jnp.asarray(toks), jnp.asarray(mask))
+        return np.asarray(out, np.float32)
+
+
+class RemoteEmbedder:
+    """OpenAI-compatible /v1/embeddings endpoint."""
+
+    def __init__(self, url: str, access_secret: str = "", dim: int = 0):
+        self.url = url.rstrip("/")
+        self.secret = access_secret
+        self.dim = dim
+
+    def embed(self, texts: Sequence[str]) -> np.ndarray:
+        req = urllib.request.Request(
+            self.url + "/v1/embeddings" if not self.url.endswith("embeddings")
+            else self.url,
+            data=json.dumps({"input": list(texts)}).encode(),
+            headers={"Content-Type": "application/json",
+                     **({"Authorization": f"Bearer {self.secret}"}
+                        if self.secret else {})})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            data = json.loads(resp.read())
+        vecs = np.asarray([d["embedding"] for d in data["data"]], np.float32)
+        if not self.dim:
+            self.dim = vecs.shape[1]
+        return vecs
+
+
+def make_embedder(cfg) -> Embedder:
+    if cfg.remote_embedding_url:
+        return RemoteEmbedder(cfg.remote_embedding_url, cfg.llm_access_secret)
+    if cfg.embedding_model_id:
+        return LocalJaxEmbedder(cfg.embedding_model_id)
+    return HashingEmbedder()

@@ -1,0 +1,232 @@
+"""Output guardrails: policy-driven response scanning.
+
+Parity with the reference's guardrails subsystem
+(``presets/ragengine/guardrails/**``: llm-guard scanner pipeline with
+block/warn actions and streaming buffer-window scanning): a YAML policy
+file declares scanners; responses are scanned post-hoc or on a sliding
+window during streaming.  Scanners are dependency-free (keyword,
+regex, secrets/PII patterns, length) with the same action semantics.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+logger = logging.getLogger(__name__)
+
+BLOCK_MESSAGE = "Response blocked by output guardrails policy ({reason})."
+
+_PII_PATTERNS = {
+    "email": re.compile(r"[\w.+-]+@[\w-]+\.[\w.]+"),
+    "phone": re.compile(r"\+?\d[\d\s().-]{8,}\d"),
+    "ssn": re.compile(r"\b\d{3}-\d{2}-\d{4}\b"),
+}
+_SECRET_PATTERNS = {
+    "aws_key": re.compile(r"AKIA[0-9A-Z]{16}"),
+    "private_key": re.compile(r"-----BEGIN [A-Z ]*PRIVATE KEY-----"),
+    "bearer": re.compile(r"(?i)bearer\s+[a-z0-9_\-\.]{20,}"),
+}
+
+
+@dataclass
+class ScanResult:
+    valid: bool
+    scanner: str = ""
+    reason: str = ""
+    action: str = "block"    # block | warn
+
+
+class Scanner:
+    name = "scanner"
+    def __init__(self, action: str = "block"):
+        self.action = action
+
+    def scan(self, text: str) -> ScanResult:
+        raise NotImplementedError
+
+
+class BanSubstrings(Scanner):
+    name = "ban_substrings"
+
+    def __init__(self, substrings: Sequence[str], case_sensitive: bool = False,
+                 action: str = "block"):
+        super().__init__(action)
+        self.case_sensitive = case_sensitive
+        self.substrings = list(substrings if case_sensitive
+                               else [s.lower() for s in substrings])
+
+    def scan(self, text: str) -> ScanResult:
+        probe = text if self.case_sensitive else text.lower()
+        for s in self.substrings:
+            if s in probe:
+                return ScanResult(False, self.name, f"banned substring {s!r}",
+                                  self.action)
+        return ScanResult(True, self.name)
+
+
+class BanTopics(Scanner):
+    """Keyword-set topic matcher (the llm-guard BanTopics analogue
+    without a classifier model: a topic fires when enough of its
+    keywords appear)."""
+
+    name = "ban_topics"
+
+    def __init__(self, topics: dict[str, Sequence[str]], threshold: int = 2,
+                 action: str = "block"):
+        super().__init__(action)
+        self.topics = {t: [k.lower() for k in kws] for t, kws in topics.items()}
+        self.threshold = threshold
+
+    def scan(self, text: str) -> ScanResult:
+        lowered = text.lower()
+        for topic, kws in self.topics.items():
+            hits = sum(1 for k in kws if k in lowered)
+            if hits >= self.threshold:
+                return ScanResult(False, self.name, f"topic {topic!r}",
+                                  self.action)
+        return ScanResult(True, self.name)
+
+
+class RegexScanner(Scanner):
+    name = "regex"
+
+    def __init__(self, patterns: Sequence[str], action: str = "block"):
+        super().__init__(action)
+        self.patterns = [re.compile(p) for p in patterns]
+
+    def scan(self, text: str) -> ScanResult:
+        for p in self.patterns:
+            if p.search(text):
+                return ScanResult(False, self.name, f"pattern {p.pattern!r}",
+                                  self.action)
+        return ScanResult(True, self.name)
+
+
+class PIIScanner(Scanner):
+    name = "pii"
+
+    def scan(self, text: str) -> ScanResult:
+        for kind, p in _PII_PATTERNS.items():
+            if p.search(text):
+                return ScanResult(False, self.name, f"PII ({kind})", self.action)
+        return ScanResult(True, self.name)
+
+
+class SecretsScanner(Scanner):
+    name = "secrets"
+
+    def scan(self, text: str) -> ScanResult:
+        for kind, p in _SECRET_PATTERNS.items():
+            if p.search(text):
+                return ScanResult(False, self.name, f"secret ({kind})",
+                                  self.action)
+        return ScanResult(True, self.name)
+
+
+class MaxLength(Scanner):
+    name = "max_length"
+
+    def __init__(self, max_chars: int, action: str = "block"):
+        super().__init__(action)
+        self.max_chars = max_chars
+
+    def scan(self, text: str) -> ScanResult:
+        if len(text) > self.max_chars:
+            return ScanResult(False, self.name,
+                              f"{len(text)} chars > {self.max_chars}",
+                              self.action)
+        return ScanResult(True, self.name)
+
+
+_SCANNER_TYPES = {
+    "ban_substrings": lambda c: BanSubstrings(
+        c.get("substrings", []), c.get("case_sensitive", False),
+        c.get("action", "block")),
+    "ban_topics": lambda c: BanTopics(
+        c.get("topics", {}), c.get("threshold", 2), c.get("action", "block")),
+    "regex": lambda c: RegexScanner(c.get("patterns", []),
+                                    c.get("action", "block")),
+    "pii": lambda c: PIIScanner(c.get("action", "block")),
+    "secrets": lambda c: SecretsScanner(c.get("action", "block")),
+    "max_length": lambda c: MaxLength(c.get("max_chars", 100000),
+                                      c.get("action", "block")),
+}
+
+
+class OutputGuardrails:
+    def __init__(self, scanners: Sequence[Scanner] = (),
+                 stream_window: int = 120):
+        self.scanners = list(scanners)
+        self.stream_window = stream_window
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.scanners)
+
+    @staticmethod
+    def from_policy_file(path: str) -> "OutputGuardrails":
+        import yaml
+
+        with open(path) as f:
+            policy = yaml.safe_load(f) or {}
+        scanners = []
+        for entry in policy.get("output_scanners", []):
+            t = entry.get("type")
+            factory = _SCANNER_TYPES.get(t)
+            if factory is None:
+                logger.warning("unknown scanner type %r ignored", t)
+                continue
+            scanners.append(factory(entry))
+        return OutputGuardrails(
+            scanners, stream_window=int(policy.get("stream_window", 120)))
+
+    def guard(self, text: str) -> ScanResult:
+        for s in self.scanners:
+            res = s.scan(text)
+            if not res.valid:
+                if res.action == "warn":
+                    logger.warning("guardrail warn: %s (%s)", res.scanner,
+                                   res.reason)
+                    continue
+                return res
+        return ScanResult(True)
+
+
+class StreamingGuard:
+    """Sliding buffer-window scanning for SSE streams (reference:
+    ``streaming/{guardrails,buffer_window}.py``): deltas accumulate in a
+    window; once a window is clean its prefix is released downstream;
+    a hit blocks the remainder of the stream."""
+
+    def __init__(self, guardrails: OutputGuardrails):
+        self.g = guardrails
+        self.buffer = ""
+        self.all_text = ""
+        self.blocked: Optional[ScanResult] = None
+
+    def feed(self, delta: str) -> tuple[str, Optional[ScanResult]]:
+        """Returns (text safe to emit now, block result if tripped)."""
+        if self.blocked:
+            return "", self.blocked
+        self.buffer += delta
+        self.all_text += delta
+        res = self.g.guard(self.all_text)
+        if not res.valid:
+            self.blocked = res
+            self.buffer = ""
+            return "", res
+        w = self.g.stream_window
+        if len(self.buffer) > w:
+            release = self.buffer[:-w]
+            self.buffer = self.buffer[-w:]
+            return release, None
+        return "", None
+
+    def flush(self) -> tuple[str, Optional[ScanResult]]:
+        if self.blocked:
+            return "", self.blocked
+        out, self.buffer = self.buffer, ""
+        return out, None
