@@ -1,0 +1,189 @@
+"""Checkpoint loading: HF safetensors -> stacked param trees.
+
+The engine's weights path for real checkpoints (the reference's pods
+download HF repos and vLLM loads them; our pods read the ModelMirror
+volume / GCS stream and this module maps HF parameter names onto the
+scan-stacked layout).  HF linear weights are [out, in]; ours are
+[in, out], so projections transpose on load.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from kaito_tpu.engine.model import TransformerLM
+
+logger = logging.getLogger(__name__)
+
+# our layer key -> (HF suffix, transpose?)
+_LAYER_MAP = {
+    "attn_norm": ("input_layernorm.weight", False),
+    "attn_norm_bias": ("input_layernorm.bias", False),
+    "mlp_norm": ("post_attention_layernorm.weight", False),
+    "mlp_norm_bias": ("post_attention_layernorm.bias", False),
+    "q": ("self_attn.q_proj.weight", True),
+    "k": ("self_attn.k_proj.weight", True),
+    "v": ("self_attn.v_proj.weight", True),
+    "o": ("self_attn.o_proj.weight", True),
+    "q_bias": ("self_attn.q_proj.bias", False),
+    "k_bias": ("self_attn.k_proj.bias", False),
+    "v_bias": ("self_attn.v_proj.bias", False),
+    "o_bias": ("self_attn.o_proj.bias", False),
+    "q_norm": ("self_attn.q_norm.weight", False),
+    "k_norm": ("self_attn.k_norm.weight", False),
+    "gate": ("mlp.gate_proj.weight", True),
+    "up": ("mlp.up_proj.weight", True),
+    "down": ("mlp.down_proj.weight", True),
+    "up_bias": ("mlp.up_proj.bias", False),
+    "down_bias": ("mlp.down_proj.bias", False),
+    "post_attn_norm": ("post_attention_layernorm.weight", False),
+    "post_mlp_norm": ("post_feedforward_layernorm.weight", False),
+}
+# gemma-3 swaps the meaning of post_attention_layernorm: pre-MLP norm is
+# pre_feedforward_layernorm
+_GEMMA_OVERRIDES = {
+    "mlp_norm": ("pre_feedforward_layernorm.weight", False),
+    "post_attn_norm": ("post_attention_layernorm.weight", False),
+}
+
+
+def _reader(directory: str) -> tuple[Callable[[str], Optional[np.ndarray]], list[str]]:
+    from safetensors import safe_open
+
+    files = sorted(f for f in os.listdir(directory) if f.endswith(".safetensors"))
+    handles = [safe_open(os.path.join(directory, f), framework="numpy")
+               for f in files]
+    key_to_handle = {}
+    for h in handles:
+        for k in h.keys():
+            key_to_handle[k] = h
+
+    def read(name: str) -> Optional[np.ndarray]:
+        h = key_to_handle.get(name)
+        if h is None:
+            return None
+        return np.asarray(h.get_tensor(name))
+
+    return read, sorted(key_to_handle)
+
+
+def load_safetensors_params(model: TransformerLM, directory: str) -> dict:
+    """Assemble the stacked param tree from HF shards on disk."""
+    arch = model.arch
+    read, all_keys = _reader(directory)
+    dtype = model.dtype
+
+    def get(name: str, required: bool = True) -> Optional[np.ndarray]:
+        for prefix in ("model.", "transformer.", ""):
+            t = read(prefix + name)
+            if t is not None:
+                return t
+        if required:
+            raise KeyError(f"missing tensor {name!r}; have e.g. {all_keys[:5]}")
+        return None
+
+    params: dict = {}
+    embed = get("embed_tokens.weight")
+    pad = model.vocab_padded - embed.shape[0]
+    if pad > 0:
+        embed = np.concatenate([embed, np.zeros((pad, embed.shape[1]),
+                                                embed.dtype)])
+    params["embed"] = jnp.asarray(embed, dtype)
+    params["final_norm"] = jnp.asarray(get("norm.weight"), dtype)
+    fnb = get("norm.bias", required=False)
+    if fnb is not None:
+        params["final_norm_bias"] = jnp.asarray(fnb, dtype)
+    if not arch.tie_word_embeddings:
+        head = read("lm_head.weight")
+        if head is None:
+            head = get("embed_tokens.weight")
+        if model.vocab_padded - head.shape[0] > 0:
+            head = np.concatenate([
+                head, np.zeros((model.vocab_padded - head.shape[0],
+                                head.shape[1]), head.dtype)])
+        params["lm_head"] = jnp.asarray(head, dtype)
+
+    layer_map = dict(_LAYER_MAP)
+    if arch.pre_post_norm:
+        layer_map.update(_GEMMA_OVERRIDES)
+
+    for g in model.groups:
+        specs = model._layer_specs(g.moe)
+        stack: dict[str, list] = {}
+        for li in range(g.start, g.start + g.count):
+            fused_qkv = None
+            for our_key in specs:
+                if "lora" in our_key:
+                    continue
+                entry = layer_map.get(our_key)
+                tensor = None
+                if entry is not None:
+                    suffix, transpose = entry
+                    tensor = get(f"layers.{li}.{suffix}", required=False)
+                    if tensor is not None and transpose:
+                        tensor = tensor.T
+                if tensor is None and our_key in ("q", "k", "v"):
+                    # phi-3 style fused qkv_proj
+                    if fused_qkv is None:
+                        fused = get(f"layers.{li}.self_attn.qkv_proj.weight",
+                                    required=False)
+                        if fused is not None:
+                            Hq = arch.num_heads * arch.head_dim
+                            Hkv = arch.num_kv_heads * arch.head_dim
+                            fused = fused.T
+                            fused_qkv = {
+                                "q": fused[:, :Hq],
+                                "k": fused[:, Hq:Hq + Hkv],
+                                "v": fused[:, Hq + Hkv:Hq + 2 * Hkv],
+                            }
+                    if fused_qkv is not None:
+                        tensor = fused_qkv[our_key]
+                if tensor is None and our_key in ("gate", "up"):
+                    # phi-3 style fused gate_up_proj
+                    fused = get(f"layers.{li}.mlp.gate_up_proj.weight",
+                                required=False)
+                    if fused is not None:
+                        fused = fused.T
+                        I = arch.intermediate_size
+                        tensor = fused[:, :I] if our_key == "gate" else fused[:, I:]
+                if tensor is None:
+                    raise KeyError(
+                        f"no source tensor for layer {li} key {our_key!r}")
+                stack.setdefault(our_key, []).append(np.asarray(tensor))
+        params[g.name] = {
+            k: jnp.asarray(np.stack(v), dtype) for k, v in stack.items()}
+    logger.info("loaded %d stacked tensors from %s", len(all_keys), directory)
+    return params
+
+
+def export_hf_state_dict(model: TransformerLM, params: dict) -> dict[str, np.ndarray]:
+    """Inverse mapping (ours -> HF names); backs tests and adapter
+    export tooling."""
+    arch = model.arch
+    out: dict[str, np.ndarray] = {}
+    out["model.embed_tokens.weight"] = np.asarray(
+        params["embed"][: arch.vocab_size])
+    out["model.norm.weight"] = np.asarray(params["final_norm"])
+    if "lm_head" in params:
+        out["lm_head.weight"] = np.asarray(params["lm_head"][: arch.vocab_size])
+    layer_map = dict(_LAYER_MAP)
+    if arch.pre_post_norm:
+        layer_map.update(_GEMMA_OVERRIDES)
+    for g in model.groups:
+        for our_key, stack in params[g.name].items():
+            entry = layer_map.get(our_key)
+            if entry is None:
+                continue
+            suffix, transpose = entry
+            for i in range(g.count):
+                t = np.asarray(stack[i])
+                # safetensors serializes raw buffers; a transposed VIEW
+                # would be written with the wrong layout
+                out[f"model.layers.{g.start + i}.{suffix}"] = (
+                    np.ascontiguousarray(t.T) if transpose else t)
+    return out

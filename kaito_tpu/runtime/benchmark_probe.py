@@ -1,0 +1,159 @@
+"""Self-benchmark startup probe.
+
+Parity with the reference's in-cluster benchmark
+(``presets/workspace/inference/vllm/benchmark_entrypoint.py``): runs as
+the leader pod's startup probe, waits for /health, derives a safe
+concurrency from the engine's KV-capacity gauges, drives a fixed load
+(60 s, 2048-token prompts / 256-token outputs), snapshots the token
+counters, and emits ``KAITO_BENCHMARK_CONFIG`` / ``KAITO_BENCHMARK_RESULT``
+JSON lines (through /proc/1/fd/1 in-pod so the controller can tail
+them), exiting 0/1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+BENCHMARK_DURATION_S = 60
+BENCHMARK_INPUT_LEN = 2048
+BENCHMARK_OUTPUT_LEN = 256
+
+
+def _emit(tag: str, payload: dict, sink: str) -> None:
+    line = f"{tag}{json.dumps(payload)}\n"
+    try:
+        with open(sink, "w") as f:
+            f.write(line)
+    except OSError:
+        sys.stdout.write(line)
+        sys.stdout.flush()
+
+
+def _get(url: str, timeout: float = 10.0) -> str:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode()
+
+
+def _metric(metrics_text: str, name: str) -> float:
+    for line in metrics_text.splitlines():
+        if line.startswith(name + " ") or line.startswith(name + "{"):
+            try:
+                return float(line.rsplit(" ", 1)[1])
+            except ValueError:
+                pass
+    return 0.0
+
+
+def wait_healthy(base: str, deadline_s: float) -> bool:
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline_s:
+        try:
+            if json.loads(_get(base + "/health"))["status"] == "ok":
+                return True
+        except Exception:
+            pass
+        time.sleep(5)
+    return False
+
+
+def derive_concurrency(base: str, input_len: int, output_len: int) -> int:
+    """Concurrency from live KV capacity (the reference reads vLLM's
+    cache-config gauges; we read kaito:kv_pages_total)."""
+    m = _get(base + "/metrics")
+    pages = _metric(m, "kaito:kv_pages_total")
+    # page size isn't exported; conservative 64-token pages
+    capacity_tokens = pages * 64
+    per_seq = input_len + output_len
+    return max(1, min(int(capacity_tokens // max(per_seq, 1)) or 1, 64))
+
+
+def run_benchmark(base: str, *, duration_s: float = BENCHMARK_DURATION_S,
+                  input_len: int = BENCHMARK_INPUT_LEN,
+                  output_len: int = BENCHMARK_OUTPUT_LEN,
+                  concurrency: int = 0, sink: str = "/proc/1/fd/1") -> dict:
+    if concurrency <= 0:
+        concurrency = derive_concurrency(base, input_len, output_len)
+    cfg = {"engine": "kaito-tpu", "engine_version": "0.1.0",
+           "input_len": input_len, "output_len": output_len,
+           "duration_s": duration_s, "max_concurrency": concurrency}
+    _emit("KAITO_BENCHMARK_CONFIG", cfg, sink)
+
+    before = _get(base + "/metrics")
+    gen0 = _metric(before, "kaito:generation_tokens_total")
+    prompt_text = "benchmark " * max(input_len // 10, 1)
+
+    stop = time.monotonic() + duration_s
+    ttfts: list[float] = []
+    errors = [0]
+
+    def worker():
+        while time.monotonic() < stop:
+            t0 = time.monotonic()
+            body = json.dumps({
+                "prompt": prompt_text, "max_tokens": output_len,
+                "temperature": 1.0, "stream": False}).encode()
+            try:
+                req = urllib.request.Request(
+                    base + "/v1/completions", data=body,
+                    headers={"Content-Type": "application/json"})
+                urllib.request.urlopen(req, timeout=duration_s + 120).read()
+                ttfts.append(time.monotonic() - t0)
+            except Exception:
+                errors[0] += 1
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(concurrency)]
+    t_start = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=duration_s + 180)
+    elapsed = time.monotonic() - t_start
+
+    after = _get(base + "/metrics")
+    gen1 = _metric(after, "kaito:generation_tokens_total")
+    total_tokens = gen1 - gen0
+    tpm = total_tokens / max(elapsed, 1e-6) * 60.0
+    ttft_p50 = _metric(after, "kaito:time_to_first_token_seconds_sum") / \
+        max(_metric(after, "kaito:time_to_first_token_seconds_count"), 1)
+    result = {
+        "vllm_total_tpm": round(tpm, 1),          # key kept for dashboard parity
+        "total_tpm": round(tpm, 1),
+        "generation_tokens": int(total_tokens),
+        "ttft_avg_ms": round(ttft_p50 * 1000, 1),
+        "elapsed_s": round(elapsed, 1),
+        "errors": errors[0],
+        "max_concurrency": concurrency,
+    }
+    _emit("KAITO_BENCHMARK_RESULT", result, sink)
+    return result
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--base-url", default="http://127.0.0.1:5000")
+    ap.add_argument("--duration", type=float, default=BENCHMARK_DURATION_S)
+    ap.add_argument("--input-len", type=int, default=BENCHMARK_INPUT_LEN)
+    ap.add_argument("--output-len", type=int, default=BENCHMARK_OUTPUT_LEN)
+    ap.add_argument("--concurrency", type=int, default=0)
+    ap.add_argument("--sink", default="/proc/1/fd/1")
+    ap.add_argument("--health-deadline", type=float, default=1800)
+    args = ap.parse_args(argv)
+    if not wait_healthy(args.base_url, args.health_deadline):
+        print("engine never became healthy", file=sys.stderr)
+        return 1
+    result = run_benchmark(
+        args.base_url, duration_s=args.duration, input_len=args.input_len,
+        output_len=args.output_len, concurrency=args.concurrency,
+        sink=args.sink)
+    return 0 if result["generation_tokens"] > 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,54 @@
+import json
+import threading
+
+import pytest
+
+from kaito_tpu.engine.config import EngineConfig
+from kaito_tpu.engine.engine import InferenceEngine
+from kaito_tpu.engine.server import make_server
+from kaito_tpu.runtime.benchmark_probe import run_benchmark, wait_healthy
+from kaito_tpu.runtime.health import coordinator_reachable, leader_http_healthy
+
+
+@pytest.fixture(scope="module")
+def served():
+    cfg = EngineConfig(model="tiny-llama-test", max_model_len=512, page_size=16,
+                       max_num_seqs=4, dtype="float32", kv_dtype="float32",
+                       prefill_buckets=(128, 256))
+    engine = InferenceEngine(cfg)
+    engine.start()
+    server = make_server(engine, cfg, host="127.0.0.1", port=0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{port}"
+    server.shutdown()
+    engine.stop()
+
+
+def test_benchmark_probe_emits_result(served, tmp_path):
+    sink = tmp_path / "out.log"
+    assert wait_healthy(served, 30)
+    result = run_benchmark(served, duration_s=3, input_len=64, output_len=16,
+                           concurrency=2, sink=str(sink))
+    assert result["generation_tokens"] > 0
+    assert result["total_tpm"] > 0
+    assert result["errors"] == 0
+    # the controller-facing contract: parseable KAITO_BENCHMARK_RESULT line
+    lines = sink.read_text()
+    assert "KAITO_BENCHMARK_RESULT" in lines
+
+
+def test_benchmark_result_line_parseable(tmp_path):
+    # the tail-parse the controller does (reference benchmark.go contract)
+    line = 'KAITO_BENCHMARK_RESULT{"total_tpm": 123.4, "ttft_avg_ms": 5}'
+    assert line.startswith("KAITO_BENCHMARK_RESULT")
+    payload = json.loads(line[len("KAITO_BENCHMARK_RESULT"):])
+    assert payload["total_tpm"] == 123.4
+
+
+def test_health_checks(served):
+    assert leader_http_healthy(served)
+    assert not leader_http_healthy("http://127.0.0.1:1")
+    host, port = served.replace("http://", "").split(":")
+    assert coordinator_reachable(f"{host}:{port}")
+    assert not coordinator_reachable("127.0.0.1:1")

@@ -1,0 +1,69 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kaito_tpu.engine.model import TransformerLM
+from kaito_tpu.engine.weights import export_hf_state_dict, load_safetensors_params
+from kaito_tpu.models import get_model_by_name
+from kaito_tpu.models.autogen import arch_from_hf_config
+
+TINY = get_model_by_name("tiny-llama-test").arch
+
+
+def test_safetensors_roundtrip(tmp_path):
+    from safetensors.numpy import save_file
+
+    model = TransformerLM(TINY, dtype=jnp.float32)
+    params = model.init_params(jax.random.PRNGKey(0))
+    sd = export_hf_state_dict(model, params)
+    save_file(sd, str(tmp_path / "model.safetensors"))
+
+    loaded = load_safetensors_params(model, str(tmp_path))
+    toks = jnp.asarray(np.random.RandomState(0).randint(0, TINY.vocab_size, (1, 8)))
+    a = model.forward_train(params, toks, remat=False)
+    b = model.forward_train(loaded, toks, remat=False)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_fused_qkv_checkpoint(tmp_path):
+    """phi-3 checkpoints store fused qkv_proj / gate_up_proj."""
+    from safetensors.numpy import save_file
+
+    arch = arch_from_hf_config({
+        "architectures": ["Phi3ForCausalLM"], "model_type": "phi3",
+        "vocab_size": 256, "hidden_size": 32, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "num_key_value_heads": 2,
+        "intermediate_size": 64, "max_position_embeddings": 128,
+        "tie_word_embeddings": True})
+    model = TransformerLM(arch, dtype=jnp.float32)
+    params = model.init_params(jax.random.PRNGKey(0))
+    sd = export_hf_state_dict(model, params)
+    # rewrite per-layer q/k/v + gate/up into fused tensors
+    for i in range(2):
+        q = sd.pop(f"model.layers.{i}.self_attn.q_proj.weight")
+        k = sd.pop(f"model.layers.{i}.self_attn.k_proj.weight")
+        v = sd.pop(f"model.layers.{i}.self_attn.v_proj.weight")
+        sd[f"model.layers.{i}.self_attn.qkv_proj.weight"] = np.concatenate([q, k, v])
+        g = sd.pop(f"model.layers.{i}.mlp.gate_proj.weight")
+        u = sd.pop(f"model.layers.{i}.mlp.up_proj.weight")
+        sd[f"model.layers.{i}.mlp.gate_up_proj.weight"] = np.concatenate([g, u])
+    save_file(sd, str(tmp_path / "model.safetensors"))
+
+    loaded = load_safetensors_params(model, str(tmp_path))
+    toks = jnp.asarray(np.random.RandomState(1).randint(0, 256, (1, 6)))
+    a = model.forward_train(params, toks, remat=False)
+    b = model.forward_train(loaded, toks, remat=False)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_missing_tensor_reports_name(tmp_path):
+    from safetensors.numpy import save_file
+
+    model = TransformerLM(TINY, dtype=jnp.float32)
+    params = model.init_params(jax.random.PRNGKey(0))
+    sd = export_hf_state_dict(model, params)
+    del sd["model.layers.1.mlp.down_proj.weight"]
+    save_file(sd, str(tmp_path / "model.safetensors"))
+    with pytest.raises(KeyError, match="down"):
+        load_safetensors_params(model, str(tmp_path))
