@@ -65,6 +65,7 @@ class TransformerLM:
         self.dtype = dtype
         self.attn_impl = attn_impl  # "jax" | "pallas" (paged decode)
         self.lora_scaling = 0.0     # set by the tuner when lora keys exist
+        self.ring = None            # (Mesh, axis) => sequence-parallel training
         self.groups = _layer_groups(arch)
         self.vocab_padded = -(-arch.vocab_size // VOCAB_ALIGN) * VOCAB_ALIGN
         # rope tables are concrete constants; computing them lazily inside
@@ -376,9 +377,18 @@ class TransformerLM:
         B, T, E = x.shape
         h = self._norm(x, p, "attn_norm")
         q, k_new, v_new = self._attn_qkv(h, p, positions, window)
-        out = attn.prefill_attention(
-            q, k_new, v_new, scale=self._scale, sliding_window=window,
-            logit_softcap=a.attn_logit_softcap, true_len=true_lens)
+        if self.ring is not None and window is None:
+            # sequence-parallel exact attention over the mesh ring;
+            # training batches are packed dense (loss masks handle pads)
+            from kaito_tpu.parallel.ring_attention import ring_attention
+
+            mesh, axis = self.ring
+            out = ring_attention(q, k_new, v_new, mesh, axis,
+                                 scale=self._scale, causal=True)
+        else:
+            out = attn.prefill_attention(
+                q, k_new, v_new, scale=self._scale, sliding_window=window,
+                logit_softcap=a.attn_logit_softcap, true_len=true_lens)
         o_in = out.reshape(B, T, a.num_heads * a.head_dim)
         attn_out = nn.linear(o_in, p["o"]) + nn.lora_delta(o_in, p, "o", self.lora_scaling)
         if "o_bias" in p:

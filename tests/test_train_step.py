@@ -64,14 +64,16 @@ def test_sharded_train_step_8dev(cpu_devices):
 
     spec = make_mesh_spec(fsdp=2, sequence=2, tensor=2)
     mesh = build_mesh(spec)
+    ring_model = TransformerLM(TINY, dtype=jnp.float32)
+    ring_model.ring = (mesh, "sequence")   # real SP in the sharded step
     with mesh:
-        state8 = shard_train_state(model, _state(model, opt), mesh)
+        state8 = shard_train_state(ring_model, _state(ring_model, opt), mesh)
         ds = data_sharding(mesh)
         batch8 = {
             "tokens": jax.device_put(batch["tokens"], ds["tokens"]),
             "mask": jax.device_put(batch["mask"], ds["mask"]),
         }
-        step8 = jax.jit(make_train_step(model, opt), donate_argnums=(0,))
+        step8 = jax.jit(make_train_step(ring_model, opt), donate_argnums=(0,))
         state8, m8 = step8(state8, batch8)
     np.testing.assert_allclose(float(m1["loss"]), float(m8["loss"]), rtol=1e-4)
 
