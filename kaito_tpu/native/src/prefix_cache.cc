@@ -1,0 +1,220 @@
+// Prefix-caching KV page allocator.
+//
+// The native runtime piece under the serving engine's KV pool: a
+// ref-counted page allocator with a radix tree over page-sized token
+// chunks, so sequences sharing a prompt prefix share pages
+// (vLLM-style automatic prefix caching, which the reference inherits
+// from its vendored engine; here it is first-party).  Exposed through a
+// C ABI consumed via ctypes (kaito_tpu/native/__init__.py).
+//
+// Concurrency: one global mutex per cache handle — the Python engine
+// calls from its scheduler thread; contention is nil.
+
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+using u64 = uint64_t;
+using i64 = int64_t;
+
+constexpr int32_t kNullPage = 0;
+
+u64 hash_chunk(const int32_t* tokens, int n, u64 seed) {
+  // FNV-1a over the chunk, chained with the parent hash so equal chunks
+  // under different prefixes map to different nodes.
+  u64 h = seed ^ 1469598103934665603ULL;
+  for (int i = 0; i < n; i++) {
+    h ^= static_cast<u64>(tokens[i]) + 0x9e3779b97f4a7c15ULL;
+    h *= 1099511628211ULL;
+  }
+  return h;
+}
+
+struct Node {
+  int32_t page = kNullPage;
+  int32_t refcount = 0;   // sequences currently holding this page
+  u64 key = 0;            // chained hash identifying this node
+  u64 parent = 0;
+  u64 lru = 0;            // last release tick
+  bool cached = true;     // false while only allocated, true once committed
+};
+
+struct PrefixCache {
+  std::mutex mu;
+  int32_t num_pages;
+  int32_t page_size;
+  u64 tick = 0;
+  std::vector<int32_t> free_pages;            // stack of free page ids
+  std::unordered_map<u64, Node> nodes;        // key -> node (committed tree)
+  std::unordered_map<int32_t, u64> page_owner;  // page -> node key
+  // stats
+  u64 hits = 0, misses = 0, evictions = 0;
+
+  explicit PrefixCache(int32_t pages, int32_t psize)
+      : num_pages(pages), page_size(psize) {
+    for (int32_t p = pages - 1; p >= 1; p--) free_pages.push_back(p);
+  }
+
+  bool evict_one() {
+    // evict the LRU committed node with refcount 0
+    u64 best_key = 0;
+    u64 best_lru = ~0ULL;
+    for (auto& [key, node] : nodes) {
+      if (node.refcount == 0 && node.lru < best_lru) {
+        best_lru = node.lru;
+        best_key = key;
+      }
+    }
+    if (best_key == 0) return false;
+    Node& n = nodes[best_key];
+    free_pages.push_back(n.page);
+    page_owner.erase(n.page);
+    nodes.erase(best_key);
+    evictions++;
+    return true;
+  }
+
+  int32_t take_page() {
+    if (free_pages.empty() && !evict_one()) return -1;
+    int32_t p = free_pages.back();
+    free_pages.pop_back();
+    return p;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* kprefix_new(int32_t num_pages, int32_t page_size) {
+  if (num_pages < 2 || page_size < 1) return nullptr;
+  return new PrefixCache(num_pages, page_size);
+}
+
+void kprefix_free(void* handle) { delete static_cast<PrefixCache*>(handle); }
+
+// Acquire pages for a sequence of n_tokens (page-aligned coverage for
+// max_tokens total).  Full pages whose chunk matches a committed node
+// are shared (ref++); the rest come from the free list.  Returns the
+// number of pages written to out_pages, and sets *out_cached_tokens to
+// the shared-prefix length in tokens.  Returns -1 on OOM (nothing is
+// held in that case).
+int32_t kprefix_acquire(void* handle, const int32_t* tokens, int32_t n_tokens,
+                        int32_t max_total_tokens, int32_t* out_pages,
+                        int32_t* out_cached_tokens) {
+  auto* c = static_cast<PrefixCache*>(handle);
+  std::lock_guard<std::mutex> lock(c->mu);
+  const int32_t ps = c->page_size;
+  const int32_t total_pages = (max_total_tokens + ps - 1) / ps;
+  const int32_t full_prompt_pages = n_tokens / ps;  // only full pages cacheable
+
+  std::vector<int32_t> pages;
+  std::vector<u64> shared_keys;
+  pages.reserve(total_pages);
+  int32_t cached_tokens = 0;
+  u64 parent = 0;
+  bool matching = true;
+
+  for (int32_t i = 0; i < total_pages; i++) {
+    if (matching && i < full_prompt_pages) {
+      u64 key = hash_chunk(tokens + i * ps, ps, parent);
+      auto it = c->nodes.find(key);
+      if (it != c->nodes.end()) {
+        it->second.refcount++;
+        pages.push_back(it->second.page);
+        shared_keys.push_back(key);
+        cached_tokens += ps;
+        parent = key;
+        c->hits++;
+        continue;
+      }
+      matching = false;
+      c->misses++;
+    }
+    int32_t p = c->take_page();
+    if (p < 0) {
+      // roll back shared refs and taken pages
+      for (u64 k : shared_keys) c->nodes[k].refcount--;
+      for (size_t j = shared_keys.size(); j < pages.size(); j++)
+        c->free_pages.push_back(pages[j]);
+      return -1;
+    }
+    pages.push_back(p);
+  }
+  std::memcpy(out_pages, pages.data(), pages.size() * sizeof(int32_t));
+  *out_cached_tokens = cached_tokens;
+  return static_cast<int32_t>(pages.size());
+}
+
+// Release a finished sequence: commit full prompt+output pages into the
+// radix tree for future reuse, decrement shared refs.  `tokens` is the
+// FULL final token sequence (prompt + generated), n_tokens its length;
+// pages are the page ids returned by acquire (n_pages of them).
+void kprefix_release(void* handle, const int32_t* tokens, int32_t n_tokens,
+                     const int32_t* pages, int32_t n_pages) {
+  auto* c = static_cast<PrefixCache*>(handle);
+  std::lock_guard<std::mutex> lock(c->mu);
+  const int32_t ps = c->page_size;
+  const int32_t full_pages =
+      std::min(n_tokens / ps, n_pages);  // only complete pages are reusable
+  c->tick++;
+  u64 parent = 0;
+  for (int32_t i = 0; i < n_pages; i++) {
+    int32_t page = pages[i];
+    if (i < full_pages) {
+      u64 key = hash_chunk(tokens + i * ps, ps, parent);
+      auto it = c->nodes.find(key);
+      if (it != c->nodes.end() && it->second.page == page) {
+        // we held a shared ref on this committed node
+        it->second.refcount--;
+        it->second.lru = c->tick;
+      } else if (it != c->nodes.end()) {
+        // same content already committed under a different page: drop ours
+        c->free_pages.push_back(page);
+      } else {
+        auto owner = c->page_owner.find(page);
+        if (owner == c->page_owner.end()) {
+          Node n;
+          n.page = page;
+          n.refcount = 0;
+          n.key = key;
+          n.parent = parent;
+          n.lru = c->tick;
+          c->nodes.emplace(key, n);
+          c->page_owner.emplace(page, key);
+        }
+      }
+      parent = key;
+    } else {
+      // tail pages (partial or generated-beyond-full): not cacheable
+      auto owner = c->page_owner.find(page);
+      if (owner == c->page_owner.end()) c->free_pages.push_back(page);
+    }
+  }
+}
+
+int32_t kprefix_available(void* handle) {
+  auto* c = static_cast<PrefixCache*>(handle);
+  std::lock_guard<std::mutex> lock(c->mu);
+  int32_t evictable = 0;
+  for (auto& [k, n] : c->nodes)
+    if (n.refcount == 0) evictable++;
+  return static_cast<int32_t>(c->free_pages.size()) + evictable;
+}
+
+void kprefix_stats(void* handle, i64* out_hits, i64* out_misses,
+                   i64* out_evictions, i64* out_cached_pages) {
+  auto* c = static_cast<PrefixCache*>(handle);
+  std::lock_guard<std::mutex> lock(c->mu);
+  *out_hits = static_cast<i64>(c->hits);
+  *out_misses = static_cast<i64>(c->misses);
+  *out_evictions = static_cast<i64>(c->evictions);
+  *out_cached_pages = static_cast<i64>(c->nodes.size());
+}
+
+}  // extern "C"

@@ -1,0 +1,111 @@
+// Flat dense vector index: contiguous row storage, vectorizable inner
+// products, partial-sort top-k.  The native backend for the RAG vector
+// store (the reference leans on FAISS; this is the first-party
+// equivalent for the flat/IP case, with the same swap-remove id
+// bookkeeping as the Python fallback).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct VecIndex {
+  std::mutex mu;
+  int32_t dim;
+  std::vector<float> data;          // n * dim, row-major
+  std::vector<int64_t> ids;
+  std::unordered_map<int64_t, int64_t> pos;  // id -> row
+
+  explicit VecIndex(int32_t d) : dim(d) {}
+};
+
+}  // namespace
+
+extern "C" {
+
+void* kvec_new(int32_t dim) {
+  if (dim <= 0) return nullptr;
+  return new VecIndex(dim);
+}
+
+void kvec_free(void* handle) { delete static_cast<VecIndex*>(handle); }
+
+int64_t kvec_size(void* handle) {
+  auto* ix = static_cast<VecIndex*>(handle);
+  std::lock_guard<std::mutex> lock(ix->mu);
+  return static_cast<int64_t>(ix->ids.size());
+}
+
+void kvec_add(void* handle, int64_t id, const float* vec) {
+  auto* ix = static_cast<VecIndex*>(handle);
+  std::lock_guard<std::mutex> lock(ix->mu);
+  auto it = ix->pos.find(id);
+  if (it != ix->pos.end()) {
+    std::memcpy(ix->data.data() + it->second * ix->dim, vec,
+                sizeof(float) * ix->dim);
+    return;
+  }
+  ix->pos.emplace(id, static_cast<int64_t>(ix->ids.size()));
+  ix->ids.push_back(id);
+  ix->data.insert(ix->data.end(), vec, vec + ix->dim);
+}
+
+int32_t kvec_remove(void* handle, int64_t id) {
+  auto* ix = static_cast<VecIndex*>(handle);
+  std::lock_guard<std::mutex> lock(ix->mu);
+  auto it = ix->pos.find(id);
+  if (it == ix->pos.end()) return 0;
+  int64_t row = it->second;
+  int64_t last = static_cast<int64_t>(ix->ids.size()) - 1;
+  if (row != last) {
+    std::memcpy(ix->data.data() + row * ix->dim,
+                ix->data.data() + last * ix->dim, sizeof(float) * ix->dim);
+    int64_t moved = ix->ids[last];
+    ix->ids[row] = moved;
+    ix->pos[moved] = row;
+  }
+  ix->ids.pop_back();
+  ix->data.resize(ix->ids.size() * ix->dim);
+  ix->pos.erase(it);
+  return 1;
+}
+
+// Export all rows (for persistence). Buffers must hold kvec_size rows.
+void kvec_export(void* handle, int64_t* out_ids, float* out_vecs) {
+  auto* ix = static_cast<VecIndex*>(handle);
+  std::lock_guard<std::mutex> lock(ix->mu);
+  std::memcpy(out_ids, ix->ids.data(), ix->ids.size() * sizeof(int64_t));
+  std::memcpy(out_vecs, ix->data.data(), ix->data.size() * sizeof(float));
+}
+
+// Top-k by inner product. Returns number of results written.
+int32_t kvec_search(void* handle, const float* query, int32_t k,
+                    int64_t* out_ids, float* out_scores) {
+  auto* ix = static_cast<VecIndex*>(handle);
+  std::lock_guard<std::mutex> lock(ix->mu);
+  const int64_t n = static_cast<int64_t>(ix->ids.size());
+  if (n == 0 || k <= 0) return 0;
+  const int32_t d = ix->dim;
+  std::vector<std::pair<float, int64_t>> scored(n);
+  const float* base = ix->data.data();
+  for (int64_t i = 0; i < n; i++) {
+    const float* row = base + i * d;
+    float s = 0.f;
+    for (int32_t j = 0; j < d; j++) s += row[j] * query[j];
+    scored[i] = {s, ix->ids[i]};
+  }
+  const int64_t kk = std::min<int64_t>(k, n);
+  std::partial_sort(scored.begin(), scored.begin() + kk, scored.end(),
+                    [](auto& a, auto& b) { return a.first > b.first; });
+  for (int64_t i = 0; i < kk; i++) {
+    out_scores[i] = scored[i].first;
+    out_ids[i] = scored[i].second;
+  }
+  return static_cast<int32_t>(kk);
+}
+
+}  // extern "C"

@@ -50,13 +50,28 @@ class RAGService:
         self.m_blocked = Counter("kaito_rag:guardrails_blocked_total",
                                  "responses blocked", self.registry)
 
+    def _dense_factory(self):
+        from kaito_tpu.rag.vector_store import FlatDenseIndex
+
+        engine = self.cfg.vector_db_engine
+        if engine in ("native", "faiss"):
+            try:
+                from kaito_tpu.native import NativeFlatIndex, load_native
+
+                if load_native() is not None:
+                    return NativeFlatIndex
+            except Exception:
+                pass
+        return FlatDenseIndex
+
     def index(self, name: str, create: bool = False) -> VectorIndex:
         with self.lock:
             idx = self.indexes.get(name)
             if idx is None:
                 if not create:
                     raise KeyError(f"index {name!r} not found")
-                idx = VectorIndex(name, self.embedder)
+                idx = VectorIndex(name, self.embedder,
+                                  dense_factory=self._dense_factory())
                 self.indexes[name] = idx
             return idx
 

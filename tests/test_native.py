@@ -1,0 +1,100 @@
+import numpy as np
+import pytest
+
+from kaito_tpu.native import NativeFlatIndex, NativePrefixCache, load_native
+
+pytestmark = pytest.mark.skipif(load_native() is None,
+                                reason="native toolchain unavailable")
+
+
+def test_prefix_cache_shares_prefix_pages():
+    c = NativePrefixCache(num_pages=64, page_size=4)
+    prompt = list(range(100, 116))  # 16 tokens = 4 full pages
+
+    pages1, cached1 = c.acquire(prompt, max_total_tokens=24)
+    assert cached1 == 0 and len(pages1) == 6
+    # finish: commit prompt pages to the tree
+    c.release(prompt + [1, 2, 3, 4], pages1)
+
+    # identical prompt: 4 prompt pages shared
+    pages2, cached2 = c.acquire(prompt, max_total_tokens=24)
+    assert cached2 == 16
+    assert pages2[:4] == pages1[:4]
+    # divergent prompt: shares only the common 2-page prefix
+    other = prompt[:8] + [999] * 8
+    pages3, cached3 = c.acquire(other, max_total_tokens=16)
+    assert cached3 == 8
+    assert pages3[:2] == pages1[:2]
+    assert pages3[2] != pages1[2]
+    c.release(prompt, pages2)
+    c.release(other, pages3)
+    stats = c.stats()
+    assert stats["hits"] >= 6 and stats["cached_pages"] >= 4
+
+
+def test_prefix_cache_eviction_under_pressure():
+    c = NativePrefixCache(num_pages=10, page_size=2)  # 9 usable
+    seqs = []
+    for s in range(4):
+        toks = [s * 50 + i for i in range(4)]  # 2 pages each
+        pages, _ = c.acquire(toks, max_total_tokens=4)
+        c.release(toks, pages)
+        seqs.append((toks, pages))
+    # tree holds 8 cached pages; allocating 6 fresh pages forces eviction
+    big = [7000 + i for i in range(12)]
+    res = c.acquire(big, max_total_tokens=12)
+    assert res is not None
+    pages, cached = res
+    assert cached == 0 and len(pages) == 6
+    assert c.stats()["evictions"] >= 1
+
+
+def test_prefix_cache_oom_rolls_back():
+    c = NativePrefixCache(num_pages=4, page_size=2)  # 3 usable
+    toks = [1, 2, 3, 4]
+    pages, _ = c.acquire(toks, max_total_tokens=6)   # takes all 3
+    assert len(pages) == 3
+    assert c.acquire([9, 9], max_total_tokens=4) is None
+    assert c.available == 0
+    c.release(toks, pages)
+    assert c.available == 3  # all reclaimable (2 cached + 1 free)
+
+
+def test_native_flat_index_matches_numpy():
+    rng = np.random.RandomState(0)
+    dim, n = 32, 200
+    vecs = rng.randn(n, dim).astype(np.float32)
+    ix = NativeFlatIndex(dim)
+    for i in range(n):
+        ix.add(f"doc-{i}", vecs[i])
+    q = rng.randn(dim).astype(np.float32)
+    got = ix.search(q, 10)
+    ref = np.argsort(-(vecs @ q))[:10]
+    assert [g[0] for g in got] == [f"doc-{i}" for i in ref]
+    np.testing.assert_allclose([g[1] for g in got], np.sort(vecs @ q)[::-1][:10],
+                               rtol=1e-5)
+
+
+def test_native_flat_index_remove_and_update():
+    ix = NativeFlatIndex(4)
+    ix.add("a", np.asarray([1, 0, 0, 0], np.float32))
+    ix.add("b", np.asarray([0, 1, 0, 0], np.float32))
+    ix.add("c", np.asarray([0, 0, 1, 0], np.float32))
+    ix.remove("b")
+    got = ix.search(np.asarray([0, 1, 0.5, 0], np.float32), 3)
+    assert [g[0] for g in got] == ["c", "a"]
+    # update in place
+    ix.add("a", np.asarray([0, 1, 0, 0], np.float32))
+    got = ix.search(np.asarray([0, 1, 0, 0], np.float32), 1)
+    assert got[0][0] == "a"
+
+
+def test_rag_store_with_native_index():
+    from kaito_tpu.rag.embeddings import HashingEmbedder
+    from kaito_tpu.rag.vector_store import VectorIndex
+
+    idx = VectorIndex("t", HashingEmbedder(), dense_factory=NativeFlatIndex)
+    idx.add_documents(["paged attention stores kv in pages",
+                       "the mitochondria is the powerhouse"])
+    hits = idx.retrieve("kv cache pages", top_k=1)
+    assert "paged attention" in hits[0]["text"]
