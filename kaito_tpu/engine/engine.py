@@ -61,6 +61,9 @@ class Request:
     params: SamplingParams
     out: "queue.SimpleQueue[Optional[int]]" = field(default_factory=queue.SimpleQueue)
     output_tokens: list[int] = field(default_factory=list)
+    # P/D disaggregation (kaito_tpu.engine.pd)
+    export_kv: bool = False                # prefill role: stage KV on finish
+    kv_import: Optional[tuple] = None      # decode role: (meta, payload, first_token)
     submit_time: float = field(default_factory=time.monotonic)
     first_token_time: Optional[float] = None
     finish_time: Optional[float] = None
@@ -184,6 +187,10 @@ class InferenceEngine:
         self._prefill_fns: dict[int, object] = {}
         self._sample_one = jax.jit(sample)
 
+        from kaito_tpu.engine.pd import KVExportRegistry
+
+        self.kv_exports = KVExportRegistry()
+
     # ------------------------------------------------------------------
     # Construction helpers
     # ------------------------------------------------------------------
@@ -277,6 +284,30 @@ class InferenceEngine:
             raise ValueError(f"max_tokens must be >= 1, got {params.max_tokens}")
         req = Request(req_id or f"req-{self.counters['requests_total']}",
                       list(prompt_tokens), params)
+        with self._lock:
+            self.counters["requests_total"] += 1
+            self._waiting_count += 1
+        self.waiting.put(req)
+        self._wake.set()
+        return req
+
+    def submit_with_kv(self, prompt_tokens: list[int], first_token: int,
+                       meta: dict, payload: bytes,
+                       params: SamplingParams,
+                       req_id: Optional[str] = None) -> Request:
+        """Decode-role entry: continue a prefilled request from
+        transferred KV pages."""
+        if len(prompt_tokens) >= self.cfg.max_model_len:
+            raise ValueError(f"prompt length {len(prompt_tokens)} exceeds "
+                             f"max_model_len {self.cfg.max_model_len}")
+        if params.max_tokens < 1:
+            raise ValueError(f"max_tokens must be >= 1, got {params.max_tokens}")
+        if meta.get("model") not in ("", None, self.md.name):
+            raise ValueError(f"KV transfer model mismatch: {meta.get('model')} "
+                             f"!= {self.md.name}")
+        req = Request(req_id or f"pd-{self.counters['requests_total']}",
+                      list(prompt_tokens), params,
+                      kv_import=(meta, payload, first_token))
         with self._lock:
             self.counters["requests_total"] += 1
             self._waiting_count += 1
@@ -409,6 +440,8 @@ class InferenceEngine:
 
     def _admit_with_pages(self, req: Request, free_slot: int,
                           pages: list[int]) -> bool:
+        if req.kv_import is not None:
+            return self._admit_imported(req, free_slot, pages)
         n = len(req.prompt_tokens)
         bucket = self._bucket(n)
         tokens = np.zeros((1, bucket), np.int32)
@@ -457,6 +490,38 @@ class InferenceEngine:
         self._emit(free_slot, first)
         return True
 
+    def _admit_imported(self, req: Request, free_slot: int,
+                        pages: list[int]) -> bool:
+        """Decode-role admission: scatter transferred KV pages and start
+        decoding at the prompt boundary (no prefill compute)."""
+        from kaito_tpu.engine.pd import import_kv
+
+        meta, payload, first = req.kv_import
+        n = len(req.prompt_tokens)
+        n_prompt_pages = -(-n // self.cfg.page_size)
+        self.cache = import_kv(self.cache, pages[:n_prompt_pages], payload, meta)
+        self.counters["prompt_tokens_total"] += n
+
+        table = np.zeros((self.pages_per_seq,), np.int32)
+        table[:len(pages)] = pages
+        self.sampling = self.sampling.set_slot(
+            free_slot, temperature=req.params.temperature,
+            top_k=req.params.top_k, top_p=req.params.top_p,
+            seed=req.params.seed or self.counters["requests_total"])
+        slot = self.slots[free_slot]
+        slot.request = req
+        slot.pages = pages
+        slot.position = n
+        slot.remaining = min(req.params.max_tokens,
+                             self.cfg.max_model_len - n)
+        self.page_tables[free_slot] = table
+        self.positions[free_slot] = n
+        self.active[free_slot] = True
+        self.last_tokens[free_slot] = first
+        req.first_token_time = time.monotonic()
+        self._emit(free_slot, first)
+        return True
+
     def _decode_once(self):
         cache, sampling, next_tokens = self._decode_fn(
             self.params, self.cache, self.sampling,
@@ -495,6 +560,18 @@ class InferenceEngine:
         if finished:
             req.finish_reason = "stop" if token in stop_ids else "length"
             req.finish_time = time.monotonic()
+            if req.export_kv:
+                from kaito_tpu.engine.pd import _Export, export_kv
+
+                n = len(req.prompt_tokens)
+                n_pages = -(-n // self.cfg.page_size)
+                meta, payload = export_kv(self.cache, slot.pages[:n_pages])
+                meta["n_tokens"] = n
+                meta["model"] = self.md.name
+                self.kv_exports.put(req.req_id, _Export(
+                    meta=meta, payload=payload,
+                    prompt_tokens=list(req.prompt_tokens),
+                    first_token=req.output_tokens[0]))
             req.out.put(None)
             self.allocator.release(slot.pages)
             slot.request = None

@@ -1,0 +1,109 @@
+"""Prefill/decode disaggregation: KV hand-off between engines.
+
+The TPU-native replacement for the reference's NIXL side-channel
+(``preset_inferences.go:909-938`` + vLLM NixlConnector,
+``inference_api.py:499-515``): the prefill engine exports a request's
+KV pages (one gather + device->host DMA), ships them over the pod
+side-channel (HTTP on the engine port), and the decode engine scatters
+them into its own pages and continues from the prompt boundary —
+no prefill compute on the decode slice.
+
+Framing: a little-endian header ``{json meta}\\n`` followed by raw
+npy-serialized K and V blocks.  Meta carries model/shape identity so
+mismatched engines fail loudly.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kaito_tpu.engine.kv_cache import KVCache
+
+logger = logging.getLogger(__name__)
+
+
+def export_kv(cache: KVCache, pages: list[int]) -> tuple[dict, bytes]:
+    """Gather a request's pages to host. Returns (meta, payload)."""
+    idx = jnp.asarray(pages, jnp.int32)
+    k = np.asarray(cache.k[:, idx])      # [L, n, Hkv, ps, D]
+    v = np.asarray(cache.v[:, idx])
+    meta = {"shape": list(k.shape), "dtype": str(k.dtype)}
+    buf = io.BytesIO()
+    np.save(buf, k, allow_pickle=False)
+    np.save(buf, v, allow_pickle=False)
+    return meta, buf.getvalue()
+
+
+def import_kv(cache: KVCache, pages: list[int], payload: bytes,
+              meta: dict) -> KVCache:
+    """Scatter transferred pages into the local pool."""
+    buf = io.BytesIO(payload)
+    k = np.load(buf, allow_pickle=False)
+    v = np.load(buf, allow_pickle=False)
+    expect = (cache.k.shape[0], len(pages)) + cache.k.shape[2:]
+    if tuple(k.shape) != expect:
+        raise ValueError(f"KV shape mismatch: got {k.shape}, cache wants {expect}")
+    idx = jnp.asarray(pages, jnp.int32)
+    dt = cache.k.dtype
+    return KVCache(k=cache.k.at[:, idx].set(jnp.asarray(k, dt)),
+                   v=cache.v.at[:, idx].set(jnp.asarray(v, dt)))
+
+
+def pack_transfer(meta: dict, payload: bytes) -> bytes:
+    head = json.dumps(meta).encode()
+    return head + b"\n" + payload
+
+
+def unpack_transfer(blob: bytes) -> tuple[dict, bytes]:
+    head, _, payload = blob.partition(b"\n")
+    return json.loads(head), payload
+
+
+@dataclass
+class _Export:
+    meta: dict
+    payload: bytes
+    prompt_tokens: list[int]
+    first_token: int
+    created: float = field(default_factory=time.monotonic)
+
+
+class KVExportRegistry:
+    """Prefill-side staging area: finished prefills wait here until the
+    decode engine pulls them (TTL-bounded so abandoned transfers don't
+    pin host memory)."""
+
+    def __init__(self, ttl_s: float = 120.0):
+        self._items: dict[str, _Export] = {}
+        self._lock = threading.Lock()
+        self.ttl_s = ttl_s
+
+    def put(self, req_id: str, exp: _Export) -> None:
+        with self._lock:
+            self._gc()
+            self._items[req_id] = exp
+
+    def pop(self, req_id: str) -> Optional[_Export]:
+        with self._lock:
+            return self._items.pop(req_id, None)
+
+    def _gc(self) -> None:
+        now = time.monotonic()
+        dead = [k for k, e in self._items.items()
+                if now - e.created > self.ttl_s]
+        for k in dead:
+            del self._items[k]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)

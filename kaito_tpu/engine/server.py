@@ -103,6 +103,8 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+        elif self.path.startswith("/pd/kv/"):
+            self._pd_kv(self.path[len("/pd/kv/"):])
         elif self.path == "/v1/models":
             models = [{"id": st.model_name, "object": "model",
                        "owned_by": "kaito-tpu", "root": st.model_name}]
@@ -118,8 +120,82 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             self._completions(chat=False)
         elif self.path == "/v1/chat/completions":
             self._completions(chat=True)
+        elif self.path == "/pd/prefill":
+            self._pd_prefill()
         else:
             self._error(404, f"no route {self.path}")
+
+    # ---------------- P/D disaggregation side-channel ----------------
+
+    def _pd_prefill(self):
+        """Prefill-role entry: run the prompt, stage its KV for pull,
+        return the first sampled token (reference counterpart: the
+        NixlConnector side-channel + llm-d routing sidecar)."""
+        st = self.state
+        body = self._read_body()
+        if body is None:
+            return
+        prompt = body.get("prompt", "")
+        if not isinstance(prompt, str) or not prompt:
+            return self._error(400, "'prompt' must be a non-empty string")
+        tokens = st.engine.tokenizer.encode(prompt)
+        params = SamplingParams(
+            max_tokens=1,
+            temperature=float(body.get("temperature", 1.0)),
+            top_k=int(body.get("top_k", 0) or 0),
+            top_p=float(body.get("top_p", 1.0)),
+            seed=int(body.get("seed", 0) or 0),
+            ignore_eos=True)
+        try:
+            req = st.engine.submit(tokens, params,
+                                   req_id=f"pd-{uuid.uuid4().hex[:16]}")
+        except ValueError as e:
+            return self._error(400, str(e))
+        req.export_kv = True
+        toks = list(req.stream())
+        if not toks and req.finish_reason == "error":
+            return self._error(500, "prefill failed")
+        self._json(200, {"req_id": req.req_id,
+                         "first_token": req.output_tokens[0],
+                         "n_tokens": len(tokens),
+                         "prompt_tokens": tokens})
+
+    def _pd_kv(self, req_id: str):
+        from kaito_tpu.engine.pd import pack_transfer
+
+        exp = self.state.engine.kv_exports.pop(req_id)
+        if exp is None:
+            return self._error(404, f"no staged KV for {req_id}")
+        blob = pack_transfer(exp.meta, exp.payload)
+        self.send_response(200)
+        self.send_header("Content-Type", "application/octet-stream")
+        self.send_header("Content-Length", str(len(blob)))
+        self.end_headers()
+        self.wfile.write(blob)
+
+    def _submit_with_transfer(self, kv_src: dict, params):
+        """Pull staged KV from the prefill pod and continue decoding."""
+        import urllib.request
+
+        from kaito_tpu.engine.pd import unpack_transfer
+
+        url = kv_src.get("source_url", "").rstrip("/")
+        req_id = kv_src.get("req_id", "")
+        if not url or not req_id:
+            self._error(400, "kv_transfer needs source_url and req_id")
+            return None
+        try:
+            with urllib.request.urlopen(f"{url}/pd/kv/{req_id}",
+                                        timeout=120) as r:
+                meta, payload = unpack_transfer(r.read())
+        except Exception as e:
+            self._error(502, f"KV pull from {url} failed: {e}")
+            return None
+        prompt_tokens = kv_src.get("prompt_tokens") or []
+        first = int(kv_src.get("first_token", 0))
+        return self.state.engine.submit_with_kv(
+            prompt_tokens, first, meta, payload, params,
+            req_id=f"cmpl-{uuid.uuid4().hex[:20]}")
 
     # ---------------- generation ----------------
 
@@ -160,9 +236,16 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         stop = body.get("stop")
         stop_strs = [stop] if isinstance(stop, str) else list(stop or [])
         tokens = st.engine.tokenizer.encode(prompt_text)
+        kv_src = body.get("kv_transfer")
         try:
-            req = st.engine.submit(tokens, params,
-                                   req_id=f"cmpl-{uuid.uuid4().hex[:20]}")
+            if kv_src:
+                req = self._submit_with_transfer(kv_src, params)
+                if req is None:
+                    return  # error already sent
+                tokens = req.prompt_tokens
+            else:
+                req = st.engine.submit(tokens, params,
+                                       req_id=f"cmpl-{uuid.uuid4().hex[:20]}")
         except ValueError as e:
             return self._error(400, str(e))
 

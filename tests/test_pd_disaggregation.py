@@ -1,0 +1,95 @@
+"""Prefill/decode disaggregation: full KV hand-off between two live
+engine servers, verified against a monolithic engine's greedy output."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kaito_tpu.engine.config import EngineConfig
+from kaito_tpu.engine.engine import InferenceEngine, SamplingParams
+from kaito_tpu.engine.server import make_server
+
+CFG = dict(model="tiny-llama-test", max_model_len=256, page_size=16,
+           max_num_seqs=2, dtype="float32", kv_dtype="float32",
+           prefill_buckets=(64, 128), seed=0)
+
+
+def _boot():
+    cfg = EngineConfig(**CFG)
+    engine = InferenceEngine(cfg)
+    engine.start()
+    server = make_server(engine, cfg, host="127.0.0.1", port=0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return engine, server, f"http://127.0.0.1:{server.server_address[1]}"
+
+
+@pytest.fixture(scope="module")
+def pd_pair():
+    prefill_engine, prefill_srv, prefill_url = _boot()
+    decode_engine, decode_srv, decode_url = _boot()
+    yield prefill_url, decode_url, prefill_engine, decode_engine
+    for s in (prefill_srv, decode_srv):
+        s.shutdown()
+    prefill_engine.stop()
+    decode_engine.stop()
+
+
+def _post(url, path, body):
+    req = urllib.request.Request(url + path, data=json.dumps(body).encode(),
+                                 headers={"Content-Type": "application/json"})
+    return json.loads(urllib.request.urlopen(req, timeout=120).read())
+
+
+def test_pd_handoff_matches_monolithic(pd_pair):
+    prefill_url, decode_url, prefill_engine, decode_engine = pd_pair
+    prompt = "hello distributed world"
+
+    # monolithic reference on the decode engine (same seed => same weights)
+    mono = _post(decode_url, "/v1/completions", {
+        "prompt": prompt, "max_tokens": 8, "temperature": 0.0})
+    mono_text = mono["choices"][0]["text"]
+
+    # 1) prefill pod computes the prompt and stages KV
+    pre = _post(prefill_url, "/pd/prefill", {
+        "prompt": prompt, "temperature": 0.0})
+    assert pre["n_tokens"] > 0
+    assert len(prefill_engine.kv_exports) == 1
+
+    # 2) decode pod pulls the KV and continues
+    out = _post(decode_url, "/v1/completions", {
+        "prompt": prompt, "max_tokens": 8, "temperature": 0.0,
+        "kv_transfer": {"source_url": prefill_url, "req_id": pre["req_id"],
+                        "prompt_tokens": pre["prompt_tokens"],
+                        "first_token": pre["first_token"]}})
+    text = out["choices"][0]["text"]
+    assert text == mono_text
+    # staged KV is consumed
+    assert len(prefill_engine.kv_exports) == 0
+
+
+def test_pd_kv_pull_404_after_consume(pd_pair):
+    prefill_url, decode_url, *_ = pd_pair
+    pre = _post(prefill_url, "/pd/prefill", {"prompt": "abc",
+                                             "temperature": 0.0})
+    blob = urllib.request.urlopen(
+        f"{prefill_url}/pd/kv/{pre['req_id']}", timeout=30).read()
+    assert len(blob) > 100
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(f"{prefill_url}/pd/kv/{pre['req_id']}",
+                               timeout=30)
+    assert e.value.code == 404
+
+
+def test_pd_decode_rejects_bad_source(pd_pair):
+    _, decode_url, *_ = pd_pair
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(decode_url, "/v1/completions", {
+            "prompt": "x", "max_tokens": 2,
+            "kv_transfer": {"source_url": "http://127.0.0.1:1",
+                            "req_id": "nope", "prompt_tokens": [1],
+                            "first_token": 0}})
+    assert e.value.code == 502
+
