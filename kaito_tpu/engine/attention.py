@@ -62,6 +62,89 @@ def prefill_attention(
     return jnp.einsum("bhts,bshd->bthd", probs.astype(v.dtype), v)
 
 
+def mla_prefill_attention(
+    q_nope: jax.Array,       # [B, T, H, dn]
+    q_rope: jax.Array,       # [B, T, H, dr] (roped)
+    c_kv: jax.Array,         # [B, T, dl]  normalized latent
+    k_rope: jax.Array,       # [B, T, dr]  (roped, shared across heads)
+    kv_b_k: jax.Array,       # [dl, H*dn]
+    kv_b_v: jax.Array,       # [dl, H*dv]
+    *,
+    scale: float,
+    true_len: Optional[jax.Array] = None,
+) -> jax.Array:
+    """DeepSeek-style latent attention over a fresh chunk.
+
+    Scores = q_nope . (c_kv @ W_uk) + q_rope . k_rope, softmax over the
+    causal window, value = c_kv @ W_uv.  Returns [B, T, H, dv].
+    """
+    B, T, H, dn = q_nope.shape
+    dv = kv_b_v.shape[1] // H
+    k_nope = (c_kv @ kv_b_k).reshape(B, T, H, dn)
+    v = (c_kv @ kv_b_v).reshape(B, T, H, dv)
+    s = jnp.einsum("bthd,bshd->bhts", q_nope, k_nope,
+                   preferred_element_type=jnp.float32)
+    s = s + jnp.einsum("bthd,bsd->bhts", q_rope, k_rope,
+                       preferred_element_type=jnp.float32)
+    s = s * scale
+    t_pos = jnp.arange(T)[:, None]
+    s_pos = jnp.arange(T)[None, :]
+    mask = s_pos <= t_pos
+    if true_len is not None:
+        mask = mask[None, :, :] & (s_pos[None] < true_len[:, None, None])
+        mask = mask[:, None]
+    s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhts,bshd->bthd", p.astype(v.dtype), v)
+
+
+def mla_paged_decode_attention(
+    q_nope: jax.Array,       # [B, H, dn]
+    q_rope: jax.Array,       # [B, H, dr]
+    cache_latent: jax.Array,  # [P, 1, ps, dl+dr]
+    page_tables: jax.Array,  # [B, pmax]
+    lengths: jax.Array,      # [B]
+    kv_b_k: jax.Array,       # [dl, H*dn]
+    kv_b_v: jax.Array,       # [dl, H*dv]
+    *,
+    scale: float,
+    kv_lora_rank: int,
+) -> jax.Array:
+    """Decode attention over the paged latent cache.
+
+    Absorption form: q_nope is projected INTO latent space
+    (q_lat = q_nope @ W_uk^T-per-head) so scores are latent dot
+    products; the output is computed in latent space then expanded by
+    W_uv — per-token K/V are never materialized (the MLA decode
+    memory win).
+    """
+    B, H, dn = q_nope.shape
+    _, _, ps, dtot = cache_latent.shape
+    dl = kv_lora_rank
+    pmax = page_tables.shape[1]
+    S = pmax * ps
+    dv = kv_b_v.shape[1] // H
+
+    lat = cache_latent[page_tables][:, :, 0]       # [B, pmax, ps, dl+dr]
+    lat = lat.reshape(B, S, dtot)
+    c_kv, k_rope = lat[..., :dl], lat[..., dl:]
+
+    wk = kv_b_k.reshape(dl, H, dn)
+    q_lat = jnp.einsum("bhd,lhd->bhl", q_nope, wk,
+                       preferred_element_type=jnp.float32)   # [B, H, dl]
+    s = jnp.einsum("bhl,bsl->bhs", q_lat, c_kv.astype(jnp.float32))
+    s = s + jnp.einsum("bhd,bsd->bhs", q_rope.astype(jnp.float32),
+                       k_rope.astype(jnp.float32))
+    s = s * scale
+    s_pos = jnp.arange(S)[None, :]
+    s = jnp.where((s_pos < lengths[:, None])[:, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out_lat = jnp.einsum("bhs,bsl->bhl", p, c_kv.astype(jnp.float32))
+    wv = kv_b_v.reshape(dl, H, dv)
+    out = jnp.einsum("bhl,lhd->bhd", out_lat, wv.astype(jnp.float32))
+    return out.astype(q_nope.dtype)
+
+
 def paged_decode_attention(
     q: jax.Array,            # [B, H, D] (one new token per sequence)
     cache_k: jax.Array,      # [num_pages, Hkv, page_size, D]

@@ -52,7 +52,13 @@ def create_kv_cache(
     page_size: int,
     dtype: jnp.dtype = jnp.bfloat16,
 ) -> KVCache:
-    shape = (arch.num_layers, num_pages, arch.num_kv_heads, page_size, arch.head_dim)
+    shape = (arch.num_layers, num_pages, arch.kv_cache_heads, page_size,
+             arch.kv_cache_dim)
+    if arch.attention_kind.value == "MLA":
+        # MLA caches one latent stream; `k` holds it, `v` is a
+        # zero-size placeholder keeping the pytree uniform
+        return KVCache(k=jnp.zeros(shape, dtype),
+                       v=jnp.zeros(shape[:-1] + (0,), dtype))
     return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
 
 

@@ -57,10 +57,7 @@ class TransformerLM:
 
     def __init__(self, arch: ModelArch, dtype=jnp.bfloat16,
                  attn_impl: str = "jax"):
-        if arch.attention_kind == AttentionKind.MLA:
-            raise NotImplementedError(
-                "MLA attention (DeepSeek V2/V3) lands with a dedicated kernel; "
-                "distilled llama/qwen checkpoints serve today")
+        self.is_mla = arch.attention_kind == AttentionKind.MLA
         self.arch = arch
         self.dtype = dtype
         self.attn_impl = attn_impl  # "jax" | "pallas" (paged decode)
@@ -70,7 +67,14 @@ class TransformerLM:
         self.vocab_padded = -(-arch.vocab_size // VOCAB_ALIGN) * VOCAB_ALIGN
         # rope tables are concrete constants; computing them lazily inside
         # a traced scan body would cache tracers
-        self._inv_freq_global = nn.rope_frequencies(arch)
+        if self.is_mla:
+            from dataclasses import replace
+
+            rope_arch = replace(arch, head_dim=arch.qk_rope_head_dim or 64,
+                                partial_rotary_factor=1.0)
+            self._inv_freq_global = nn.rope_frequencies(rope_arch)
+        else:
+            self._inv_freq_global = nn.rope_frequencies(arch)
         self._inv_freq_local = self._make_inv_freq_local()
 
     # ------------------------------------------------------------------
@@ -81,13 +85,35 @@ class TransformerLM:
         a = self.arch
         E, H, Hkv, D, I = (a.hidden_size, a.num_heads, a.num_kv_heads,
                            a.head_dim, a.intermediate_size)
-        specs: dict[str, tuple[tuple[int, ...], tuple]] = {
-            "attn_norm": ((E,), ("embed",)),
-            "q": ((E, H * D), ("embed", "heads")),
-            "k": ((E, Hkv * D), ("embed", "kv_heads")),
-            "v": ((E, Hkv * D), ("embed", "kv_heads")),
-            "o": ((H * D, E), ("heads", "embed")),
-        }
+        if self.is_mla:
+            dn = a.qk_nope_head_dim or D
+            dr = a.qk_rope_head_dim or 64
+            dv = a.v_head_dim or D
+            dl = a.kv_lora_rank or 512
+            specs: dict[str, tuple[tuple[int, ...], tuple]] = {
+                "attn_norm": ((E,), ("embed",)),
+                "kv_a": ((E, dl + dr), ("embed", None)),
+                "kv_a_norm": ((dl,), (None,)),
+                "kv_b_k": ((dl, H * dn), (None, "heads")),
+                "kv_b_v": ((dl, H * dv), (None, "heads")),
+                "o": ((H * dv, E), ("heads", "embed")),
+            }
+            if a.q_lora_rank:
+                specs.update({
+                    "q_a": ((E, a.q_lora_rank), ("embed", None)),
+                    "q_a_norm": ((a.q_lora_rank,), (None,)),
+                    "q_b": ((a.q_lora_rank, H * (dn + dr)), (None, "heads")),
+                })
+            else:
+                specs["q"] = ((E, H * (dn + dr)), ("embed", "heads"))
+        else:
+            specs = {
+                "attn_norm": ((E,), ("embed",)),
+                "q": ((E, H * D), ("embed", "heads")),
+                "k": ((E, Hkv * D), ("embed", "kv_heads")),
+                "v": ((E, Hkv * D), ("embed", "kv_heads")),
+                "o": ((H * D, E), ("heads", "embed")),
+            }
         if a.qkv_bias or a.linear_bias:
             specs.update({
                 "q_bias": ((H * D,), ("heads",)),
@@ -218,8 +244,66 @@ class TransformerLM:
     @property
     def _scale(self) -> float:
         a = self.arch
+        if self.is_mla:
+            return 1.0 / math.sqrt((a.qk_nope_head_dim or a.head_dim)
+                                   + (a.qk_rope_head_dim or 0))
         denom = a.query_pre_attn_scalar if a.query_pre_attn_scalar else a.head_dim
         return 1.0 / math.sqrt(denom)
+
+    # ------------------------------------------------------------------
+    # MLA (DeepSeek-style latent attention)
+    # ------------------------------------------------------------------
+
+    def _mla_attention(self, h, p, ck, cv, mode, *, positions, page_tables,
+                       lengths, true_lens, active):
+        """Latent attention: project to a shared compressed KV latent,
+        cache only [c_kv ; k_rope], expand per-head K/V on use (prefill)
+        or absorb projections into the query (decode)."""
+        a = self.arch
+        B, T, E = h.shape
+        H = a.num_heads
+        dn = a.qk_nope_head_dim or a.head_dim
+        dr = a.qk_rope_head_dim or 64
+        dl = a.kv_lora_rank or 512
+
+        if "q_a" in p:
+            q_lat = nn.rms_norm(h @ p["q_a"], p["q_a_norm"], a.rms_norm_eps, False)
+            q = q_lat @ p["q_b"]
+        else:
+            q = h @ p["q"]
+        q = q.reshape(B, T, H, dn + dr)
+        q_nope, q_rope = q[..., :dn], q[..., dn:]
+        q_rope = nn.apply_rope(q_rope, positions, self._inv_freq_global, dr)
+
+        kv = h @ p["kv_a"]                       # [B, T, dl+dr]
+        c_kv = nn.rms_norm(kv[..., :dl], p["kv_a_norm"], a.rms_norm_eps, False)
+        k_rope = nn.apply_rope(kv[..., dl:][:, :, None, :], positions,
+                               self._inv_freq_global, dr)[:, :, 0]
+        latent = jnp.concatenate([c_kv, k_rope], axis=-1)  # [B, T, dl+dr]
+
+        if mode == "train":
+            out = attn.mla_prefill_attention(
+                q_nope, q_rope, c_kv, k_rope, p["kv_b_k"], p["kv_b_v"],
+                scale=self._scale, true_len=true_lens)
+        elif mode == "prefill":
+            ps = ck.shape[-2]
+            start = jnp.zeros((B,), jnp.int32)
+            ck = write_prefill_tokens(ck, latent[:, :, None, :], page_tables,
+                                      start, true_lens, ps)
+            out = attn.mla_prefill_attention(
+                q_nope, q_rope, c_kv, k_rope, p["kv_b_k"], p["kv_b_v"],
+                scale=self._scale, true_len=true_lens)
+        else:
+            ps = ck.shape[-2]
+            ck = write_decode_tokens(ck, latent[:, 0][:, None, :], page_tables,
+                                     positions[:, 0], ps, active)
+            out = attn.mla_paged_decode_attention(
+                q_nope[:, 0], q_rope[:, 0], ck, page_tables, lengths,
+                p["kv_b_k"], p["kv_b_v"], scale=self._scale,
+                kv_lora_rank=dl)[:, None]
+        dv = a.v_head_dim or a.head_dim
+        attn_out = out.reshape(B, T, H * dv) @ p["o"]
+        return attn_out, ck, cv
 
     # ------------------------------------------------------------------
     # Layer body (shared by prefill and decode via mode switch)
@@ -272,6 +356,16 @@ class TransformerLM:
         a = self.arch
         B, T, E = x.shape
         h = self._norm(x, p, "attn_norm")
+        if self.is_mla:
+            attn_out, ck, cv = self._mla_attention(
+                h, p, ck, cv, mode, positions=positions,
+                page_tables=page_tables, lengths=lengths,
+                true_lens=true_lens, active=active)
+            if a.parallel_residual:
+                return x + attn_out + self._mlp(h, p, moe), ck, cv
+            x = x + attn_out
+            h2 = self._norm(x, p, "mlp_norm")
+            return x + self._mlp(h2, p, moe), ck, cv
         q, k_new, v_new = self._attn_qkv(h, p, positions, window)
         ps = ck.shape[-2]
 
@@ -376,6 +470,16 @@ class TransformerLM:
         a = self.arch
         B, T, E = x.shape
         h = self._norm(x, p, "attn_norm")
+        if self.is_mla:
+            attn_out, _, _ = self._mla_attention(
+                h, p, None, None, "train", positions=positions,
+                page_tables=None, lengths=None, true_lens=true_lens,
+                active=None)
+            if a.parallel_residual:
+                return x + attn_out + self._mlp(h, p, moe)
+            x = x + attn_out
+            h2 = self._norm(x, p, "mlp_norm")
+            return x + self._mlp(h2, p, moe)
         q, k_new, v_new = self._attn_qkv(h, p, positions, window)
         if self.ring is not None and window is None:
             # sequence-parallel exact attention over the mesh ring;

@@ -89,6 +89,18 @@ class ModelArch:
     v_head_dim: Optional[int] = None
 
     @property
+    def kv_cache_heads(self) -> int:
+        """Head count of the KV cache: MLA caches ONE shared latent."""
+        return 1 if self.attention_kind == AttentionKind.MLA else self.num_kv_heads
+
+    @property
+    def kv_cache_dim(self) -> int:
+        """Per-head cache dim: MLA caches [kv_lora_rank + rope] latents."""
+        if self.attention_kind == AttentionKind.MLA:
+            return (self.kv_lora_rank or 0) + (self.qk_rope_head_dim or 0)
+        return self.head_dim
+
+    @property
     def attention_kind(self) -> AttentionKind:
         if self.kv_lora_rank:
             return AttentionKind.MLA
