@@ -134,7 +134,7 @@ class InferenceEngine:
             arch, dtype=self.dtype,
             attn_impl="pallas" if use_pallas else "jax")
         self.tokenizer = load_tokenizer(self.md.hf_id, arch.vocab_size)
-        self.mesh = mesh
+        self.mesh = mesh if mesh is not None else self._build_mesh()
 
         if not cfg.max_model_len:
             cfg.max_model_len = min(self.md.max_model_len, 8192)
@@ -147,6 +147,10 @@ class InferenceEngine:
         num_pages = max(num_pages, cfg.max_num_seqs * self.pages_per_seq // 4 + 2)
         self.cache = create_kv_cache(arch, num_pages, cfg.page_size,
                                      jnp.dtype(cfg.kv_dtype))
+        if self.mesh is not None:
+            sh = self._cache_sharding()
+            self.cache = KVCache(k=jax.device_put(self.cache.k, sh),
+                                 v=jax.device_put(self.cache.v, sh))
         logger.info("KV cache: %d pages x %d tokens (%.2f GiB)",
                     num_pages, cfg.page_size,
                     2 * self.cache.k.nbytes / 2**30)
@@ -195,11 +199,55 @@ class InferenceEngine:
     # Construction helpers
     # ------------------------------------------------------------------
 
+    def _build_mesh(self):
+        """TP mesh from config (the planner's tensor axis): weights and
+        KV heads shard across chips; XLA inserts the collectives."""
+        tp = self.cfg.tensor_parallel
+        if tp <= 1:
+            return None
+        from kaito_tpu.parallel.mesh import build_mesh
+        from kaito_tpu.parallel.plan import make_mesh_spec
+
+        devices = jax.devices()
+        if len(devices) < tp:
+            raise ValueError(f"tensor_parallel={tp} but only "
+                             f"{len(devices)} devices visible")
+        return build_mesh(make_mesh_spec(tensor=tp), devices[:tp])
+
+    def _param_shardings(self):
+        from jax.sharding import NamedSharding
+
+        from kaito_tpu.parallel.sharding import SERVE_RULES
+
+        axes = self.model.param_logical_axes()
+        return jax.tree.map(
+            lambda ax: NamedSharding(self.mesh, SERVE_RULES.spec(ax)),
+            axes, is_leaf=lambda x: isinstance(x, tuple))
+
+    def _cache_sharding(self):
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        # [L, pages, kv_heads, page_size, D]: shard the kv-head axis
+        # (replicated when MLA's single latent stream can't split)
+        if self.md.arch.kv_cache_heads % self.mesh.shape["tensor"] == 0 \
+                and self.md.arch.kv_cache_heads > 1:
+            return NamedSharding(self.mesh, P(None, None, "tensor"))
+        return NamedSharding(self.mesh, P())
+
     def _init_params(self):
-        logger.info("initializing synthetic weights for %s", self.md.name)
+        logger.info("initializing synthetic weights for %s (mesh=%s)",
+                    self.md.name, self.mesh)
         t0 = time.monotonic()
-        with jax.default_device(jax.devices()[0]):
-            params = jax.jit(self.model.init_params)(jax.random.PRNGKey(self.cfg.seed))
+        if self.mesh is not None:
+            params = jax.jit(
+                self.model.init_params,
+                out_shardings=self._param_shardings())(
+                    jax.random.PRNGKey(self.cfg.seed))
+        else:
+            with jax.default_device(jax.devices()[0]):
+                params = jax.jit(self.model.init_params)(
+                    jax.random.PRNGKey(self.cfg.seed))
         jax.block_until_ready(params)
         logger.info("weights ready in %.1fs (%.2f GiB)",
                     time.monotonic() - t0,

@@ -375,6 +375,8 @@ def main(argv=None):
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--max-model-len", type=int, default=0)
     ap.add_argument("--max-num-seqs", type=int, default=8)
+    ap.add_argument("--tensor-parallel-size", type=int,
+                    default=int(os.environ.get("KAITO_TENSOR_PARALLEL", "1")))
     ap.add_argument("--served-model-name", default="")
     ap.add_argument("--dtype", default="")
     ap.add_argument("--kaito-config-file", default="")
@@ -389,6 +391,7 @@ def main(argv=None):
     cfg = EngineConfig(
         model=args.model, port=args.port, max_model_len=args.max_model_len,
         max_num_seqs=args.max_num_seqs, served_model_name=args.served_model_name,
+        tensor_parallel=args.tensor_parallel_size,
         dtype=args.dtype or ("bfloat16" if on_tpu else "float32"),
         kv_dtype=args.dtype or ("bfloat16" if on_tpu else "float32"),
         adapters_dir=args.kaito_adapters_dir,

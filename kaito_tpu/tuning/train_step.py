@@ -67,19 +67,39 @@ def make_train_step(model: TransformerLM, optimizer: optax.GradientTransformatio
 
 
 def param_shardings(model: TransformerLM, mesh: Mesh,
-                    rules: PartitionRules = TRAIN_RULES):
-    """NamedShardings for every param from its logical axes."""
+                    rules: PartitionRules = TRAIN_RULES, params=None):
+    """NamedShardings for every param from its logical axes.
+
+    When ``params`` is given, shardings follow ITS structure: leaves
+    absent from the logical-axes tree (lora factors, quantized-weight
+    sub-dicts) replicate — they are tiny or already per-layer stacked.
+    """
     axes = model.param_logical_axes()
-    return jax.tree.map(
-        lambda ax: NamedSharding(mesh, rules.spec(ax)),
-        axes, is_leaf=lambda x: isinstance(x, tuple))
+    if params is None:
+        return jax.tree.map(
+            lambda ax: NamedSharding(mesh, rules.spec(ax)),
+            axes, is_leaf=lambda x: isinstance(x, tuple))
+
+    def spec_for(path, leaf):
+        node = axes
+        for part in path:
+            k = getattr(part, "key", None)
+            if isinstance(node, dict) and k in node:
+                node = node[k]
+            else:
+                return NamedSharding(mesh, P())
+        if isinstance(node, tuple) and len(node) == getattr(leaf, "ndim", -1):
+            return NamedSharding(mesh, rules.spec(node))
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
 
 
 def shard_train_state(model: TransformerLM, state: TrainState, mesh: Mesh,
                       rules: PartitionRules = TRAIN_RULES) -> TrainState:
     """Place params + optimizer state on the mesh (optimizer moments
     share the param sharding; scalars replicate)."""
-    p_sh = param_shardings(model, mesh, rules)
+    p_sh = param_shardings(model, mesh, rules, params=state.params)
 
     def place(x, sh):
         return jax.device_put(x, sh)

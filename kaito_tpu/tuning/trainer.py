@@ -63,6 +63,8 @@ class Trainer:
         self.model = TransformerLM(self.md.arch, dtype=jnp.dtype(cfg.dtype))
         self.tokenizer = load_tokenizer(self.md.hf_id, self.md.arch.vocab_size)
         self.mesh = mesh
+        if mesh is not None and mesh.shape.get("sequence", 1) > 1:
+            self.model.ring = (mesh, "sequence")
 
         key = jax.random.PRNGKey(cfg.seed)
         params = self.model.init_params(key)
@@ -91,6 +93,10 @@ class Trainer:
         self.state = TrainState(params=params,
                                 opt_state=self.optimizer.init(train_leaves),
                                 step=jnp.zeros((), jnp.int32))
+        if self.mesh is not None:
+            from kaito_tpu.tuning.train_step import shard_train_state
+
+            self.state = shard_train_state(self.model, self.state, self.mesh)
         self._step_fn = jax.jit(self._make_step(), donate_argnums=(0,))
 
     def _make_step(self):
@@ -190,6 +196,11 @@ class Trainer:
                     step += 1
                     continue  # fast-forward through resumed steps
                 jb = {k: jnp.asarray(v) for k, v in batch.items()}
+                if self.mesh is not None:
+                    from kaito_tpu.tuning.train_step import data_sharding
+
+                    ds_sh = data_sharding(self.mesh)
+                    jb = {k: jax.device_put(v, ds_sh[k]) for k, v in jb.items()}
                 self.state, metrics = self._step_fn(self.state, jb)
                 step += 1
                 loss = float(metrics["loss"])

@@ -1,0 +1,40 @@
+"""Tensor-parallel serving on the virtual device mesh: a tp=2 engine
+must reproduce the single-device engine's greedy decode exactly."""
+
+import numpy as np
+import pytest
+
+from kaito_tpu.engine.config import EngineConfig
+from kaito_tpu.engine.engine import InferenceEngine, SamplingParams
+
+BASE = dict(model="tiny-llama-test", max_model_len=128, page_size=16,
+            max_num_seqs=2, dtype="float32", kv_dtype="float32",
+            prefill_buckets=(32,), seed=0)
+
+
+def _run(engine, prompt, n=8):
+    engine.start()
+    try:
+        p = SamplingParams(max_tokens=n, temperature=0.0, ignore_eos=True)
+        return list(engine.submit(prompt, p).stream())
+    finally:
+        engine.stop()
+
+
+def test_tp2_matches_single_device(cpu_devices):
+    single = InferenceEngine(EngineConfig(**BASE))
+    ref = _run(single, [5, 6, 7, 8])
+
+    tp2 = InferenceEngine(EngineConfig(**BASE, tensor_parallel=2))
+    assert tp2.mesh is not None
+    assert tp2.mesh.shape["tensor"] == 2
+    out = _run(tp2, [5, 6, 7, 8])
+    assert out == ref
+    # params actually sharded: q proj heads-dim split across 2 devices
+    q = tp2.params["dense"]["q"]
+    assert len(q.sharding.device_set) == 2
+
+
+def test_tp_too_wide_raises():
+    with pytest.raises(ValueError, match="devices"):
+        InferenceEngine(EngineConfig(**BASE, tensor_parallel=64))
