@@ -1,0 +1,39 @@
+# kaito-tpu build & test surface (counterpart of the reference Makefile
+# targets: unit-test, inference-api-e2e, rag-service-test, bench).
+
+PYTHON ?= python
+
+.PHONY: all native unit-test engine-test rag-test bench serve manager clean
+
+all: native
+
+native:
+	$(MAKE) -C kaito_tpu/native
+
+unit-test:
+	$(PYTHON) -m pytest tests/ -q
+
+engine-test:
+	$(PYTHON) -m pytest tests/test_engine_core.py tests/test_engine_model.py \
+	  tests/test_server.py tests/test_pallas_ops.py -q
+
+rag-test:
+	$(PYTHON) -m pytest tests/test_rag.py -q
+
+bench:
+	$(PYTHON) bench.py
+
+serve:
+	$(PYTHON) -m kaito_tpu.engine.server --model $${MODEL:-tiny-llama-test}
+
+manager:
+	$(PYTHON) -m kaito_tpu.controllers.manager
+
+docker-engine:
+	docker build -f docker/engine/Dockerfile -t ghcr.io/kaito-tpu/engine:latest .
+
+docker-manager:
+	docker build -f docker/manager/Dockerfile -t ghcr.io/kaito-tpu/manager:latest .
+
+clean:
+	$(MAKE) -C kaito_tpu/native clean

@@ -1,0 +1,118 @@
+"""MT-Bench quality harness.
+
+Parity with the reference's quality benchmark (``benchmarks/mt_bench``
+job + ``model_catalog_mtbench_scores.md``): drive a served model through
+multi-turn MT-Bench questions over the OpenAI API, then score with a
+judge model.  Question set and judge prompt ship in-tree; the full
+80-question set drops in via ``--questions`` (jsonl with
+{question_id, category, turns:[...]}).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import urllib.request
+
+JUDGE_PROMPT = (
+    "You are an impartial judge. Rate the AI assistant's answer to the "
+    "user question on a 1-10 scale for helpfulness, relevance, accuracy, "
+    "depth, and clarity. Reply with ONLY a JSON object "
+    '{{"rating": <1-10>, "explanation": "..."}}.\n\n'
+    "[Question]\n{question}\n\n[Answer]\n{answer}\n")
+
+# a representative in-tree slice of the MT-Bench categories
+BUILTIN_QUESTIONS = [
+    {"question_id": 81, "category": "writing", "turns": [
+        "Compose an engaging travel blog post about a recent trip to Hawaii, "
+        "highlighting cultural experiences and must-see attractions.",
+        "Rewrite your previous response. Start every sentence with the letter A."]},
+    {"question_id": 101, "category": "reasoning", "turns": [
+        "Imagine you are participating in a race with a group of people. If "
+        "you have just overtaken the second person, what's your current "
+        "position? Where is the person you just overtook?",
+        "If the \"second person\" is changed to \"last person\" in the above "
+        "question, what would the answer be?"]},
+    {"question_id": 121, "category": "coding", "turns": [
+        "Develop a Python program that reads all the text files under a "
+        "directory and returns the top-5 words with the most occurrences.",
+        "Can you parallelize it?"]},
+    {"question_id": 111, "category": "math", "turns": [
+        "The vertices of a triangle are at points (0, 0), (-1, 1), and "
+        "(3, 3). What is the area of the triangle?",
+        "What's the area of the circle circumscribing the triangle?"]},
+]
+
+
+def _chat(base: str, messages: list[dict], max_tokens: int = 512,
+          temperature: float = 0.7) -> str:
+    req = urllib.request.Request(
+        base.rstrip("/") + "/v1/chat/completions",
+        data=json.dumps({"messages": messages, "max_tokens": max_tokens,
+                         "temperature": temperature}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=600) as r:
+        out = json.loads(r.read())
+    return out["choices"][0]["message"]["content"]
+
+
+def run(model_url: str, judge_url: str, questions: list[dict],
+        max_tokens: int) -> dict:
+    per_category: dict[str, list[float]] = {}
+    records = []
+    for q in questions:
+        messages: list[dict] = []
+        answers = []
+        for turn in q["turns"]:
+            messages.append({"role": "user", "content": turn})
+            answer = _chat(model_url, messages, max_tokens=max_tokens)
+            messages.append({"role": "assistant", "content": answer})
+            answers.append(answer)
+        ratings = []
+        for turn, answer in zip(q["turns"], answers):
+            judge_out = _chat(judge_url, [{
+                "role": "user",
+                "content": JUDGE_PROMPT.format(question=turn, answer=answer),
+            }], max_tokens=256, temperature=0.0)
+            try:
+                start = judge_out.find("{")
+                rating = float(json.loads(judge_out[start:]).get("rating", 0))
+            except (ValueError, json.JSONDecodeError):
+                rating = 0.0
+            ratings.append(rating)
+        score = statistics.mean(ratings) if ratings else 0.0
+        per_category.setdefault(q.get("category", "other"), []).append(score)
+        records.append({"question_id": q["question_id"], "score": score})
+    summary = {
+        "overall": round(statistics.mean(
+            r["score"] for r in records), 2) if records else 0.0,
+        "categories": {c: round(statistics.mean(v), 2)
+                       for c, v in per_category.items()},
+        "records": records,
+    }
+    return summary
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model-url", required=True,
+                    help="OpenAI endpoint of the model under test")
+    ap.add_argument("--judge-url", required=True,
+                    help="OpenAI endpoint of the judge model")
+    ap.add_argument("--questions", default="",
+                    help="jsonl question file (default: built-in slice)")
+    ap.add_argument("--max-tokens", type=int, default=512)
+    args = ap.parse_args(argv)
+    questions = BUILTIN_QUESTIONS
+    if args.questions:
+        with open(args.questions) as f:
+            questions = [json.loads(l) for l in f if l.strip()]
+    summary = run(args.model_url, args.judge_url, questions, args.max_tokens)
+    print(json.dumps(summary, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

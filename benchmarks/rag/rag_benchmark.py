@@ -1,0 +1,77 @@
+"""RAG retrieval benchmark.
+
+Parity with ``benchmarks/rag/rag_benchmark_docs.py``: index a document
+corpus into a live RAG service, run retrieval queries with known
+relevant documents, report hit-rate@k and latency percentiles.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import time
+import urllib.request
+
+CORPUS = [
+    ("k8s-operators", "Kubernetes operators extend the API with custom "
+     "resources and reconcile the desired state through controllers."),
+    ("tpu-ici", "TPU v5e slices connect chips over a 2D torus inter-chip "
+     "interconnect; multi-slice training rides the data-center network."),
+    ("paged-attention", "Paged attention manages the KV cache in fixed-size "
+     "pages addressed through per-sequence page tables."),
+    ("lora", "LoRA fine-tuning trains low-rank adapter matrices while the "
+     "base model weights stay frozen."),
+    ("ring-attention", "Ring attention rotates key-value shards around the "
+     "device ring so each chip holds one sequence shard."),
+    ("bm25", "BM25 ranks documents by term frequency, inverse document "
+     "frequency and length normalization."),
+]
+QUERIES = [
+    ("how do controllers reconcile custom resources?", "k8s-operators"),
+    ("what interconnect joins tpu chips?", "tpu-ici"),
+    ("how is the kv cache organized in pages?", "paged-attention"),
+    ("training adapters with frozen base weights", "lora"),
+    ("rotating kv shards around devices", "ring-attention"),
+]
+
+
+def _post(base: str, path: str, body: dict) -> dict:
+    req = urllib.request.Request(base.rstrip("/") + path,
+                                 data=json.dumps(body).encode(),
+                                 headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return json.loads(r.read())
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rag-url", required=True)
+    ap.add_argument("--top-k", type=int, default=3)
+    args = ap.parse_args(argv)
+
+    _post(args.rag_url, "/index", {
+        "index_name": "bench",
+        "documents": [{"text": text, "metadata": {"doc": name}}
+                      for name, text in CORPUS]})
+    hits, lats = 0, []
+    for query, expected in QUERIES:
+        t0 = time.monotonic()
+        out = _post(args.rag_url, "/retrieve", {
+            "index_name": "bench", "query": query, "top_k": args.top_k})
+        lats.append(time.monotonic() - t0)
+        got = [r["metadata"].get("doc") for r in out["results"]]
+        hits += int(expected in got)
+    lats.sort()
+    print(json.dumps({
+        "hit_rate_at_k": round(hits / len(QUERIES), 3),
+        "p50_ms": round(lats[len(lats) // 2] * 1000, 1),
+        "p95_ms": round(lats[int(len(lats) * 0.95)] * 1000, 1),
+        "queries": len(QUERIES),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
