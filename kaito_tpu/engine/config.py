@@ -33,6 +33,7 @@ class EngineConfig:
     port: int = 5000
     served_model_name: str = ""
     adapters_dir: str = ""               # LoRA adapter discovery dir
+    weights_dir: str = ""                # safetensors checkpoint dir ("" = synthetic)
     disable_rate_limit: bool = False
     max_queue_len: int = 256
 

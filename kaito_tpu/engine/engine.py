@@ -236,6 +236,15 @@ class InferenceEngine:
         return NamedSharding(self.mesh, P())
 
     def _init_params(self):
+        if self.cfg.weights_dir:
+            from kaito_tpu.engine.weights import load_safetensors_params
+
+            logger.info("loading checkpoint from %s", self.cfg.weights_dir)
+            params = load_safetensors_params(self.model, self.cfg.weights_dir)
+            if self.mesh is not None:
+                params = jax.tree.map(jax.device_put, params,
+                                      self._param_shardings())
+            return params
         logger.info("initializing synthetic weights for %s (mesh=%s)",
                     self.md.name, self.mesh)
         t0 = time.monotonic()

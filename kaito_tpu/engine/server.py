@@ -381,6 +381,8 @@ def main(argv=None):
     ap.add_argument("--dtype", default="")
     ap.add_argument("--kaito-config-file", default="")
     ap.add_argument("--kaito-adapters-dir", default="")
+    ap.add_argument("--weights-dir",
+                    default=os.environ.get("KAITO_WEIGHTS_DIR", ""))
     ap.add_argument("--kaito-disable-rate-limit", action="store_true")
     ap.add_argument("--max-queue-len", type=int, default=256)
     args = ap.parse_args(argv)
@@ -395,6 +397,7 @@ def main(argv=None):
         dtype=args.dtype or ("bfloat16" if on_tpu else "float32"),
         kv_dtype=args.dtype or ("bfloat16" if on_tpu else "float32"),
         adapters_dir=args.kaito_adapters_dir,
+        weights_dir=args.weights_dir,
         disable_rate_limit=args.kaito_disable_rate_limit,
         max_queue_len=args.max_queue_len,
     )

@@ -1,0 +1,102 @@
+"""kubectl-to-tokens e2e simulation.
+
+The closest in-process analogue of the reference's cluster e2e suite
+(test/e2e/preset_vllm_test.go, which needs a real cluster + quota): a
+Workspace flows through the manager against the fake cloud, the
+rendered StatefulSet's engine command is actually BOOTED, the benchmark
+probe runs against it, and its result lands in workspace status the way
+the controller contract specifies.
+"""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from kaito_tpu.api import InferenceSpec, ObjectMeta, ResourceSpec, Workspace
+from kaito_tpu.api.meta import condition_true
+from kaito_tpu.api.workspace import (
+    COND_BENCHMARK_COMPLETE,
+    COND_INFERENCE_READY,
+    COND_WORKSPACE_SUCCEEDED,
+)
+from kaito_tpu.controllers.manager import Manager
+from kaito_tpu.controllers.workspace import BENCH_METRIC_PEAK_TPM
+from kaito_tpu.engine.config import EngineConfig
+from kaito_tpu.engine.engine import InferenceEngine
+from kaito_tpu.engine.server import make_server
+from kaito_tpu.provision import FakeCloud
+from kaito_tpu.runtime.benchmark_probe import run_benchmark, wait_healthy
+
+
+def test_workspace_to_tokens(tmp_path):
+    mgr = Manager()
+    cloud = FakeCloud(mgr.store)
+
+    ws = Workspace(
+        ObjectMeta(name="e2e"),
+        resource=ResourceSpec(instance_type="ct5lp-hightpu-1t"),
+        inference=InferenceSpec(preset="tiny-llama-test"))
+    mgr.store.create(ws)
+    for _ in range(6):
+        mgr.resync()
+        cloud.tick()
+
+    # the manager produced the workload; now "kubelet" boots the
+    # rendered engine command for real
+    ss = mgr.store.get("StatefulSet", "default", "e2e")
+    cmd = ss.spec["template"]["spec"]["containers"][0]["command"]
+    assert cmd[:3] == ["python", "-m", "kaito_tpu.engine.server"]
+    args = dict(zip(cmd[3::2], cmd[4::2]))
+    assert args["--model"] == "tiny-llama-test"
+
+    cfg = EngineConfig(model=args["--model"],
+                       max_model_len=min(int(args["--max-model-len"]), 512),
+                       page_size=16, max_num_seqs=4, dtype="float32",
+                       kv_dtype="float32", prefill_buckets=(128, 256))
+    engine = InferenceEngine(cfg)
+    engine.start()
+    server = make_server(engine, cfg, host="127.0.0.1", port=0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{port}"
+    try:
+        # startup probe: the self-benchmark, exactly as the StatefulSet
+        # probes run it
+        assert wait_healthy(base, 60)
+        sink = tmp_path / "probe.log"
+        result = run_benchmark(base, duration_s=2, input_len=32,
+                               output_len=8, concurrency=2, sink=str(sink))
+        assert result["generation_tokens"] > 0
+
+        # pod log line -> controller contract: feed the result back the
+        # way the kubelet/status pipeline would
+        line = [l for l in sink.read_text().splitlines()
+                if l.startswith("KAITO_BENCHMARK_RESULT")][0]
+        payload = json.loads(line[len("KAITO_BENCHMARK_RESULT"):])
+        from kaito_tpu.controllers.runtime import update_with_retry
+
+        def attach(o):
+            o.status["benchmark"] = payload
+        update_with_retry(mgr.store, "StatefulSet", "default", "e2e", attach)
+        mgr.resync()
+
+        live = mgr.store.get("Workspace", "default", "e2e")
+        assert condition_true(live.status.conditions, COND_INFERENCE_READY)
+        assert condition_true(live.status.conditions, COND_WORKSPACE_SUCCEEDED)
+        assert condition_true(live.status.conditions, COND_BENCHMARK_COMPLETE)
+        assert live.status.performance.metrics[BENCH_METRIC_PEAK_TPM] == \
+            payload["total_tpm"]
+
+        # and the service actually serves OpenAI traffic
+        req = urllib.request.Request(
+            base + "/v1/chat/completions",
+            data=json.dumps({"messages": [{"role": "user", "content": "hi"}],
+                             "max_tokens": 4, "temperature": 0.0}).encode(),
+            headers={"Content-Type": "application/json"})
+        out = json.loads(urllib.request.urlopen(req, timeout=60).read())
+        assert out["choices"][0]["message"]["role"] == "assistant"
+    finally:
+        server.shutdown()
+        engine.stop()
