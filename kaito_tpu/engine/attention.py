@@ -62,6 +62,50 @@ def prefill_attention(
     return jnp.einsum("bhts,bshd->bthd", probs.astype(v.dtype), v)
 
 
+def paged_context_attention(
+    q: jax.Array,            # [B, T, H, D] chunk queries
+    cache_k: jax.Array,      # [P, Hkv, ps, D] (chunk KV already written)
+    cache_v: jax.Array,
+    page_tables: jax.Array,  # [B, pmax]
+    start_pos: jax.Array,    # [B] absolute position of q[:, 0]
+    true_lens: jax.Array,    # [B] valid NEW tokens in the chunk
+    *,
+    scale: float,
+    sliding_window: Optional[jax.Array] = None,
+    logit_softcap: Optional[float] = None,
+) -> jax.Array:
+    """Chunked prefill WITH prior context: queries attend over the whole
+    paged history (cached prefix + the freshly-written chunk) with
+    absolute-position causal masking.  Backs prefix-cache reuse and
+    long-prompt chunked prefill."""
+    B, T, H, D = q.shape
+    _, Hkv, ps, _ = cache_k.shape
+    pmax = page_tables.shape[1]
+    S = pmax * ps
+    groups = H // Hkv
+
+    k = cache_k[page_tables]                      # [B, pmax, Hkv, ps, D]
+    v = cache_v[page_tables]
+    k = jnp.moveaxis(k, 2, 3).reshape(B, S, Hkv, D)
+    v = jnp.moveaxis(v, 2, 3).reshape(B, S, Hkv, D)
+    k = _gqa_expand(k, groups)
+    v = _gqa_expand(v, groups)
+
+    scores = jnp.einsum("bthd,bshd->bhts", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if logit_softcap:
+        scores = jnp.tanh(scores / logit_softcap) * logit_softcap
+    q_pos = start_pos[:, None] + jnp.arange(T)[None, :]       # [B, T]
+    k_pos = jnp.arange(S)[None, :]                            # [1, S]
+    mask = k_pos[:, None, :] <= q_pos[:, :, None]             # [B, T, S]
+    mask &= (k_pos < (start_pos + true_lens)[:, None])[:, None, :]
+    if sliding_window is not None:
+        mask &= k_pos[:, None, :] > q_pos[:, :, None] - sliding_window
+    scores = jnp.where(mask[:, None], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhts,bshd->bthd", probs.astype(v.dtype), v)
+
+
 def mla_prefill_attention(
     q_nope: jax.Array,       # [B, T, H, dn]
     q_rope: jax.Array,       # [B, T, H, dr] (roped)

@@ -35,6 +35,7 @@ class EngineConfig:
     adapters_dir: str = ""               # LoRA adapter discovery dir
     weights_dir: str = ""                # safetensors checkpoint dir ("" = synthetic)
     disable_rate_limit: bool = False
+    enable_prefix_caching: bool = True   # native radix-tree prefix reuse
     max_queue_len: int = 256
 
     def replace(self, **kw) -> "EngineConfig":

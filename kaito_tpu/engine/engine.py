@@ -161,7 +161,19 @@ class InferenceEngine:
 
             self.params = apply_adapters_to_params(self.model, self.params,
                                                    cfg.adapters_dir)
-        self.allocator = PageAllocator(num_pages)
+        self.prefix_cache = None
+        if cfg.enable_prefix_caching and not self.model.is_mla \
+                and self.mesh is None:
+            try:
+                from kaito_tpu.native import NativePrefixCache
+
+                self.prefix_cache = NativePrefixCache(num_pages, cfg.page_size)
+                logger.info("prefix caching enabled (native radix tree)")
+            except Exception:
+                logger.info("native prefix cache unavailable; plain allocator")
+        # the prefix cache subsumes the free-list (same available/num_pages
+        # surface for metrics)
+        self.allocator = self.prefix_cache or PageAllocator(num_pages)
         S = cfg.max_num_seqs
         self.slots = [_Slot() for _ in range(S)]
         self.page_tables = np.zeros((S, self.pages_per_seq), np.int32)
@@ -185,6 +197,7 @@ class InferenceEngine:
             "requests_finished_total": 0,
             "prefill_steps_total": 0,
             "decode_steps_total": 0,
+            "prefix_cached_tokens_total": 0,
         }
 
         self._decode_fn = self._build_decode_fn()
@@ -313,6 +326,24 @@ class InferenceEngine:
             self._prefill_fns[bucket] = fn
         return fn
 
+    def _prefill_ctx_fn(self, bucket: int):
+        key = ("ctx", bucket)
+        fn = self._prefill_fns.get(key)
+        if fn is None:
+            model = self.model
+
+            @partial(jax.jit, donate_argnums=(1,))
+            def prefill_ctx(params, cache, tokens, true_lens, page_tables,
+                            start_pos):
+                cache, logits, _ = model.prefill(params, cache, tokens,
+                                                 true_lens, page_tables,
+                                                 start_pos=start_pos)
+                return cache, logits
+
+            fn = prefill_ctx
+            self._prefill_fns[key] = fn
+        return fn
+
     def _bucket(self, n: int) -> int:
         for b in self.buckets:
             if n <= b:
@@ -408,6 +439,13 @@ class InferenceEngine:
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
 
+    def _release_pages(self, req: Request, pages: list[int]):
+        if self.prefix_cache is not None:
+            self.prefix_cache.release(
+                list(req.prompt_tokens) + list(req.output_tokens), pages)
+        else:
+            self.allocator.release(pages)
+
     def _fail_request(self, req: Request):
         req.finish_reason = "error"
         req.finish_time = time.monotonic()
@@ -417,7 +455,7 @@ class InferenceEngine:
         for i, slot in enumerate(self.slots):
             if slot.request is not None:
                 self._fail_request(slot.request)
-                self.allocator.release(slot.pages)
+                self._release_pages(slot.request, slot.pages)
                 slot.request, slot.pages = None, []
                 self.active[i] = False
         while True:
@@ -480,6 +518,22 @@ class InferenceEngine:
     def _admit(self, req: Request, free_slot: int) -> bool:
         n = len(req.prompt_tokens)
         max_total = min(n + req.params.max_tokens, self.cfg.max_model_len)
+        if self.prefix_cache is not None:
+            res = self.prefix_cache.acquire(req.prompt_tokens, max_total)
+            if res is None:
+                self.waiting.put(req)
+                with self._lock:
+                    self._waiting_count += 1
+                return False
+            pages, cached = res
+            # at least one suffix token must run to produce logits; the
+            # overlap rewrites identical KV into the shared page
+            cached = min(cached, n - 1)
+            try:
+                return self._admit_with_pages(req, free_slot, pages, cached)
+            except Exception:
+                self.prefix_cache.release(list(req.prompt_tokens), pages)
+                raise
         pages_needed = -(-max_total // self.cfg.page_size)
         if pages_needed > self.allocator.available:
             # not enough KV memory: requeue and stall admission
@@ -496,21 +550,32 @@ class InferenceEngine:
             raise
 
     def _admit_with_pages(self, req: Request, free_slot: int,
-                          pages: list[int]) -> bool:
+                          pages: list[int], cached: int = 0) -> bool:
         if req.kv_import is not None:
             return self._admit_imported(req, free_slot, pages)
         n = len(req.prompt_tokens)
-        bucket = self._bucket(n)
+        suffix = req.prompt_tokens[cached:]
+        m = len(suffix)
+        bucket = self._bucket(m)
         tokens = np.zeros((1, bucket), np.int32)
-        tokens[0, :n] = req.prompt_tokens
+        tokens[0, :m] = suffix
         table = np.zeros((self.pages_per_seq,), np.int32)
         table[:len(pages)] = pages
 
-        fn = self._prefill_fn(bucket)
-        self.cache, logits = fn(self.params, self.cache,
-                                jnp.asarray(tokens),
-                                jnp.asarray([n], np.int32),
-                                jnp.asarray(table[None]))
+        if cached:
+            fn = self._prefill_ctx_fn(bucket)
+            self.cache, logits = fn(self.params, self.cache,
+                                    jnp.asarray(tokens),
+                                    jnp.asarray([m], np.int32),
+                                    jnp.asarray(table[None]),
+                                    jnp.asarray([cached], np.int32))
+            self.counters["prefix_cached_tokens_total"] += cached
+        else:
+            fn = self._prefill_fn(bucket)
+            self.cache, logits = fn(self.params, self.cache,
+                                    jnp.asarray(tokens),
+                                    jnp.asarray([n], np.int32),
+                                    jnp.asarray(table[None]))
         self.counters["prefill_steps_total"] += 1
         self.counters["prompt_tokens_total"] += n
 
@@ -630,7 +695,7 @@ class InferenceEngine:
                     prompt_tokens=list(req.prompt_tokens),
                     first_token=req.output_tokens[0]))
             req.out.put(None)
-            self.allocator.release(slot.pages)
+            self._release_pages(req, slot.pages)
             slot.request = None
             slot.pages = []
             self.active[slot_idx] = False

@@ -351,7 +351,8 @@ class TransformerLM:
         return nn.rms_norm(x, p[name], self.arch.rms_norm_eps, self.arch.norm_offset)
 
     def _layer(self, x, p, ck, cv, window, moe, mode, *,
-               positions, page_tables, lengths, true_lens, active):
+               positions, page_tables, lengths, true_lens, active,
+               start_pos=None):
         """One transformer block. Returns (x, ck, cv)."""
         a = self.arch
         B, T, E = x.shape
@@ -370,13 +371,21 @@ class TransformerLM:
         ps = ck.shape[-2]
 
         if mode == "prefill":
-            start = jnp.zeros((B,), jnp.int32)
+            start = (start_pos if start_pos is not None
+                     else jnp.zeros((B,), jnp.int32))
             ck = write_prefill_tokens(ck, k_new, page_tables, start, true_lens, ps)
             cv = write_prefill_tokens(cv, v_new, page_tables, start, true_lens, ps)
-            out = attn.prefill_attention(
-                q, k_new, v_new, scale=self._scale,
-                sliding_window=window, logit_softcap=a.attn_logit_softcap,
-                true_len=true_lens)
+            if start_pos is not None:
+                # chunk attends over cached context + itself (prefix reuse)
+                out = attn.paged_context_attention(
+                    q, ck, cv, page_tables, start, true_lens,
+                    scale=self._scale, sliding_window=window,
+                    logit_softcap=a.attn_logit_softcap)
+            else:
+                out = attn.prefill_attention(
+                    q, k_new, v_new, scale=self._scale,
+                    sliding_window=window, logit_softcap=a.attn_logit_softcap,
+                    true_len=true_lens)
         else:
             ck = write_decode_tokens(ck, k_new[:, 0], page_tables,
                                      positions[:, 0], ps, active)
@@ -420,7 +429,7 @@ class TransformerLM:
 
     def _run_layers(self, params, cache: Optional[KVCache], x, mode, *,
                     positions, page_tables, lengths, true_lens, active,
-                    remat: bool = False):
+                    remat: bool = False, start_pos=None):
         new_k, new_v = [], []
         for g in self.groups:
             stack = params[g.name]
@@ -452,7 +461,8 @@ class TransformerLM:
                 h, ck_l, cv_l = self._layer(
                     h, p, ck_l, cv_l, window, moe, mode,
                     positions=positions, page_tables=page_tables,
-                    lengths=lengths, true_lens=true_lens, active=active)
+                    lengths=lengths, true_lens=true_lens, active=active,
+                    start_pos=start_pos)
                 return h, (ck_l, cv_l)
 
             xs = (stack, ck_g, cv_g) if flags is None else (stack, ck_g, cv_g, flags)
@@ -520,20 +530,23 @@ class TransformerLM:
         logits = nn.softcap(logits, self.arch.final_logit_softcap)
         return logits[..., : self.arch.vocab_size]
 
-    def prefill(self, params, cache: KVCache, tokens, true_lens, page_tables):
-        """Process fresh prompts.
+    def prefill(self, params, cache: KVCache, tokens, true_lens, page_tables,
+                start_pos=None):
+        """Process prompts (or prompt suffixes when ``start_pos`` marks a
+        cached/chunked prefix already present in the pages).
 
-        tokens: [B, T] padded prompts; true_lens: [B]; page_tables:
-        [B, pages_per_seq] pre-allocated.  Returns (cache, last_logits
-        [B, vocab], last_hidden [B, E]).
+        tokens: [B, T] padded chunks; true_lens: [B] valid NEW tokens;
+        page_tables: [B, pages_per_seq] pre-allocated.  Returns (cache,
+        last_logits [B, vocab], last_hidden [B, E]).
         """
         B, T = tokens.shape
-        positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+        rel = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+        positions = rel if start_pos is None else rel + start_pos[:, None]
         x = self._embed(params, tokens)
         x, cache = self._run_layers(
             params, cache, x, "prefill", positions=positions,
             page_tables=page_tables, lengths=true_lens, true_lens=true_lens,
-            active=None)
+            active=None, start_pos=start_pos)
         x = self._norm(x, params, "final_norm")
         last = jnp.take_along_axis(
             x, (true_lens - 1)[:, None, None].astype(jnp.int32), axis=1)[:, 0]
