@@ -36,6 +36,8 @@ class EngineConfig:
     weights_dir: str = ""                # safetensors checkpoint dir ("" = synthetic)
     disable_rate_limit: bool = False
     enable_prefix_caching: bool = True   # native radix-tree prefix reuse
+    pd_enabled: bool = False             # P/D side-channel routes (MRI roles)
+    pd_source_allowlist: str = ""        # comma URL prefixes for KV pulls
     max_queue_len: int = 256
 
     def replace(self, **kw) -> "EngineConfig":

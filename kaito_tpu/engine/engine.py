@@ -363,7 +363,8 @@ class InferenceEngine:
         return int(self.active.sum())
 
     def submit(self, prompt_tokens: list[int], params: SamplingParams,
-               req_id: Optional[str] = None) -> Request:
+               req_id: Optional[str] = None,
+               export_kv: bool = False) -> Request:
         if len(prompt_tokens) >= self.cfg.max_model_len:
             raise ValueError(
                 f"prompt length {len(prompt_tokens)} exceeds max_model_len "
@@ -371,7 +372,7 @@ class InferenceEngine:
         if params.max_tokens < 1:
             raise ValueError(f"max_tokens must be >= 1, got {params.max_tokens}")
         req = Request(req_id or f"req-{self.counters['requests_total']}",
-                      list(prompt_tokens), params)
+                      list(prompt_tokens), params, export_kv=export_kv)
         with self._lock:
             self.counters["requests_total"] += 1
             self._waiting_count += 1
@@ -441,8 +442,11 @@ class InferenceEngine:
 
     def _release_pages(self, req: Request, pages: list[int]):
         if self.prefix_cache is not None:
-            self.prefix_cache.release(
-                list(req.prompt_tokens) + list(req.output_tokens), pages)
+            # imported-KV pages are never committed (token list unknown
+            # to be trustworthy); everything else feeds the radix tree
+            tokens = [] if req.kv_import is not None else \
+                list(req.prompt_tokens) + list(req.output_tokens)
+            self.prefix_cache.release(tokens, pages)
         else:
             self.allocator.release(pages)
 
@@ -451,13 +455,16 @@ class InferenceEngine:
         req.finish_time = time.monotonic()
         req.out.put(None)
 
-    def _fail_all(self):
+    def _fail_active_slots(self):
         for i, slot in enumerate(self.slots):
             if slot.request is not None:
                 self._fail_request(slot.request)
                 self._release_pages(slot.request, slot.pages)
                 slot.request, slot.pages = None, []
                 self.active[i] = False
+
+    def _fail_all(self):
+        self._fail_active_slots()
         while True:
             try:
                 req = self.waiting.get_nowait()
@@ -479,9 +486,25 @@ class InferenceEngine:
             poisoned = True
         if poisoned:
             logger.warning("KV cache was donated into a failed step; rebuilding")
+            # device contents are gone: nothing in flight may survive and
+            # the prefix tree must not advertise zeroed pages
+            self._fail_active_slots()
+            num_pages = self.allocator.num_pages
+            if self.prefix_cache is not None:
+                from kaito_tpu.native import NativePrefixCache
+
+                self.prefix_cache = NativePrefixCache(num_pages,
+                                                      self.cfg.page_size)
+                self.allocator = self.prefix_cache
+            else:
+                self.allocator = PageAllocator(num_pages)
             self.cache = create_kv_cache(
-                self.md.arch, self.allocator.num_pages, self.cfg.page_size,
+                self.md.arch, num_pages, self.cfg.page_size,
                 jnp.dtype(self.cfg.kv_dtype))
+            if self.mesh is not None:
+                sh = self._cache_sharding()
+                self.cache = KVCache(k=jax.device_put(self.cache.k, sh),
+                                     v=jax.device_put(self.cache.v, sh))
 
     def step(self) -> bool:
         """One scheduler iteration. Returns False when idle."""
@@ -519,7 +542,12 @@ class InferenceEngine:
         n = len(req.prompt_tokens)
         max_total = min(n + req.params.max_tokens, self.cfg.max_model_len)
         if self.prefix_cache is not None:
-            res = self.prefix_cache.acquire(req.prompt_tokens, max_total)
+            # PD imports carry foreign KV bytes: acquire EXCLUSIVE pages
+            # (empty-token acquire shares nothing) so a transfer can
+            # neither overwrite shared pages nor commit into the tree
+            acquire_tokens = [] if req.kv_import is not None \
+                else req.prompt_tokens
+            res = self.prefix_cache.acquire(acquire_tokens, max_total)
             if res is None:
                 self.waiting.put(req)
                 with self._lock:

@@ -127,7 +127,12 @@ class OpenAIHandler(BaseHTTPRequestHandler):
 
     # ---------------- P/D disaggregation side-channel ----------------
 
+    def _pd_enabled(self) -> bool:
+        return bool(self.state.cfg.pd_enabled)
+
     def _pd_prefill(self):
+        if not self._pd_enabled():
+            return self._error(403, "P/D disaggregation disabled on this pod")
         """Prefill-role entry: run the prompt, stage its KV for pull,
         return the first sampled token (reference counterpart: the
         NixlConnector side-channel + llm-d routing sidecar)."""
@@ -148,10 +153,10 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             ignore_eos=True)
         try:
             req = st.engine.submit(tokens, params,
-                                   req_id=f"pd-{uuid.uuid4().hex[:16]}")
+                                   req_id=f"pd-{uuid.uuid4().hex[:16]}",
+                                   export_kv=True)
         except ValueError as e:
             return self._error(400, str(e))
-        req.export_kv = True
         toks = list(req.stream())
         if not toks and req.finish_reason == "error":
             return self._error(500, "prefill failed")
@@ -161,6 +166,8 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                          "prompt_tokens": tokens})
 
     def _pd_kv(self, req_id: str):
+        if not self._pd_enabled():
+            return self._error(403, "P/D disaggregation disabled on this pod")
         from kaito_tpu.engine.pd import pack_transfer
 
         exp = self.state.engine.kv_exports.pop(req_id)
@@ -179,10 +186,17 @@ class OpenAIHandler(BaseHTTPRequestHandler):
 
         from kaito_tpu.engine.pd import unpack_transfer
 
+        if not self._pd_enabled():
+            self._error(403, "P/D disaggregation disabled on this pod")
+            return None
         url = kv_src.get("source_url", "").rstrip("/")
         req_id = kv_src.get("req_id", "")
         if not url or not req_id:
             self._error(400, "kv_transfer needs source_url and req_id")
+            return None
+        allow = [p for p in self.state.cfg.pd_source_allowlist.split(",") if p]
+        if allow and not any(url.startswith(pref) for pref in allow):
+            self._error(403, f"kv_transfer source {url!r} not in allowlist")
             return None
         try:
             with urllib.request.urlopen(f"{url}/pd/kv/{req_id}",
@@ -383,6 +397,10 @@ def main(argv=None):
     ap.add_argument("--kaito-adapters-dir", default="")
     ap.add_argument("--weights-dir",
                     default=os.environ.get("KAITO_WEIGHTS_DIR", ""))
+    ap.add_argument("--pd-enabled", action="store_true",
+                    default=os.environ.get("KAITO_PD_ENABLED", "") == "true")
+    ap.add_argument("--pd-source-allowlist",
+                    default=os.environ.get("KAITO_PD_ALLOWLIST", ""))
     ap.add_argument("--kaito-disable-rate-limit", action="store_true")
     ap.add_argument("--max-queue-len", type=int, default=256)
     args = ap.parse_args(argv)
@@ -398,6 +416,8 @@ def main(argv=None):
         kv_dtype=args.dtype or ("bfloat16" if on_tpu else "float32"),
         adapters_dir=args.kaito_adapters_dir,
         weights_dir=args.weights_dir,
+        pd_enabled=args.pd_enabled,
+        pd_source_allowlist=args.pd_source_allowlist,
         disable_rate_limit=args.kaito_disable_rate_limit,
         max_queue_len=args.max_queue_len,
     )

@@ -72,6 +72,14 @@ def engine_env(ws: Workspace, md: ModelMetadata, plan: ParallelPlan) -> list[dic
          "value": coordinator_address(ws.metadata.name, ws.metadata.namespace)},
         {"name": "KAITO_TPU_TOPOLOGY", "value": plan.topology},
     ]
+    role = ws.metadata.annotations.get("kaito-tpu.io/inference-role", "")
+    if role:
+        # P/D roles enable the KV side-channel, restricted to in-cluster
+        # peers of this MRI (reference: NIXL env + routing sidecar,
+        # preset_inferences.go:909-985)
+        env.append({"name": "KAITO_PD_ENABLED", "value": "true"})
+        env.append({"name": "KAITO_PD_ALLOWLIST",
+                    "value": f"http://{ws.metadata.labels.get('kaito-tpu.io/multirole-inference', ws.metadata.name)}-"})
     if md.download_auth_required:
         env.append({"name": "HF_TOKEN", "valueFrom": {"secretKeyRef": {
             "name": f"{ws.metadata.name}-hf-token", "key": "token",

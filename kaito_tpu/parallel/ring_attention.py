@@ -24,13 +24,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh
 from jax.sharding import PartitionSpec as P
 
-NEG_INF = -1e30
-
-
-def _gqa_expand(x: jax.Array, groups: int) -> jax.Array:
-    if groups == 1:
-        return x
-    return jnp.repeat(x, groups, axis=-2)
+from kaito_tpu.engine.attention import NEG_INF, _gqa_expand
 
 
 def _ring_local(q, k, v, *, axis_name: str, scale: float, causal: bool):

@@ -14,7 +14,7 @@ from kaito_tpu.engine.server import make_server
 
 CFG = dict(model="tiny-llama-test", max_model_len=256, page_size=16,
            max_num_seqs=2, dtype="float32", kv_dtype="float32",
-           prefill_buckets=(64, 128), seed=0)
+           prefill_buckets=(64, 128), seed=0, pd_enabled=True)
 
 
 def _boot():
