@@ -1,0 +1,64 @@
+import base64
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from kaito_tpu.controllers.webhook import make_server
+
+
+@pytest.fixture(scope="module")
+def webhook():
+    server = make_server(host="127.0.0.1", port=0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{port}"
+    server.shutdown()
+
+
+def _review(kind, obj, uid="u1"):
+    return {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+            "request": {"uid": uid, "kind": {"kind": kind}, "object": obj}}
+
+
+def _post(url, path, body):
+    req = urllib.request.Request(url + path, data=json.dumps(body).encode(),
+                                 headers={"Content-Type": "application/json"})
+    return json.loads(urllib.request.urlopen(req, timeout=30).read())
+
+
+def test_validate_accepts_good_workspace(webhook):
+    out = _post(webhook, "/validate", _review("Workspace", {
+        "metadata": {"name": "ok"},
+        "resource": {"instanceType": "ct5lp-hightpu-4t"},
+        "inference": {"preset": "phi-4-mini-instruct"},
+    }))
+    assert out["response"]["allowed"] is True
+    assert out["response"]["uid"] == "u1"
+
+
+def test_validate_rejects_bad_workspace(webhook):
+    out = _post(webhook, "/validate", _review("Workspace", {
+        "metadata": {"name": "bad"},
+        "inference": {"preset": "nope-model"},
+    }))
+    assert out["response"]["allowed"] is False
+    assert "preset" in out["response"]["status"]["message"]
+
+
+def test_default_patches_count(webhook):
+    out = _post(webhook, "/default", _review("Workspace", {
+        "metadata": {"name": "d"},
+        "resource": {"instanceType": "ct5lp-hightpu-1t", "count": 0},
+        "inference": {"preset": "phi-4"},
+    }))
+    assert out["response"]["allowed"] is True
+    patch = json.loads(base64.b64decode(out["response"]["patch"]))
+    assert patch[0]["path"] == "/resource/count"
+    assert patch[0]["value"] == 1
+
+
+def test_unknown_kind_passes(webhook):
+    out = _post(webhook, "/validate", _review("ConfigMap", {"metadata": {}}))
+    assert out["response"]["allowed"] is True
