@@ -57,6 +57,24 @@ def main():
     import jax
     import jax.numpy as jnp
 
+    # fast-fail when the accelerator runtime is wedged: a tiny op must
+    # complete within 180s or we emit the diagnostic line immediately
+    probe_done = threading.Event()
+
+    def _probe():
+        jnp.asarray([1.0]).block_until_ready()
+        probe_done.set()
+
+    threading.Thread(target=_probe, daemon=True).start()
+    if not probe_done.wait(timeout=180):
+        log("device probe hung; accelerator runtime is wedged")
+        print(json.dumps({
+            "metric": "decode_throughput", "value": 0.0,
+            "unit": "tokens/s/chip", "vs_baseline": 0.0,
+            "error": "device attach hung for 180s (wedged accelerator runtime)",
+        }), flush=True)
+        return
+
     from kaito_tpu.engine.kv_cache import create_kv_cache
     from kaito_tpu.engine.model import TransformerLM
     from kaito_tpu.models import get_model_by_name
