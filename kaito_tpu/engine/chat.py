@@ -2,27 +2,100 @@
 
 The reference ships per-model jinja chat templates
 (``presets/workspace/inference/chat_templates/*.jinja``, 14 files) fed
-to vLLM's ``--chat-template``.  We use the HF tokenizer's own template
-when one is locally available and fall back to a generic ChatML-style
-rendering otherwise (serving synthetic checkpoints, tests).
+to vLLM's ``--chat-template``.  We prefer the HF tokenizer's own
+template when locally available; otherwise a model-family template
+(llama-3, chatml/qwen, gemma, phi, mistral-inst, deepseek) selected
+from the model id, falling back to generic ChatML.
 """
 
 from __future__ import annotations
 
-from typing import Mapping, Sequence
+from typing import Mapping, Optional, Sequence
 
 
-def render_chat(tokenizer, messages: Sequence[Mapping[str, str]]) -> str:
+def _llama3(messages) -> str:
+    out = ["<|begin_of_text|>"]
+    for m in messages:
+        out.append(f"<|start_header_id|>{m.get('role', 'user')}"
+                   f"<|end_header_id|>\n\n{m.get('content', '')}<|eot_id|>")
+    out.append("<|start_header_id|>assistant<|end_header_id|>\n\n")
+    return "".join(out)
+
+
+def _chatml(messages) -> str:
+    out = []
+    for m in messages:
+        out.append(f"<|im_start|>{m.get('role', 'user')}\n"
+                   f"{m.get('content', '')}<|im_end|>\n")
+    out.append("<|im_start|>assistant\n")
+    return "".join(out)
+
+
+def _gemma(messages) -> str:
+    out = ["<bos>"]
+    for m in messages:
+        role = "model" if m.get("role") == "assistant" else "user"
+        out.append(f"<start_of_turn>{role}\n{m.get('content', '')}<end_of_turn>\n")
+    out.append("<start_of_turn>model\n")
+    return "".join(out)
+
+
+def _phi(messages) -> str:
+    out = []
+    for m in messages:
+        out.append(f"<|{m.get('role', 'user')}|>\n{m.get('content', '')}<|end|>\n")
+    out.append("<|assistant|>\n")
+    return "".join(out)
+
+
+def _mistral(messages) -> str:
+    out = ["<s>"]
+    system = ""
+    for m in messages:
+        role, content = m.get("role"), m.get("content", "")
+        if role == "system":
+            system = content
+        elif role == "user":
+            body = f"{system}\n\n{content}" if system else content
+            system = ""
+            out.append(f"[INST] {body} [/INST]")
+        else:
+            out.append(f" {content}</s>")
+    return "".join(out)
+
+
+def _generic(messages) -> str:
+    parts = []
+    for m in messages:
+        parts.append(f"<|{m.get('role', 'user')}|>\n{m.get('content', '')}\n")
+    parts.append("<|assistant|>\n")
+    return "".join(parts)
+
+
+_FAMILY_TEMPLATES = (
+    (("llama-3", "llama3", "deepseek-r1-distill-llama"), _llama3),
+    (("qwen", "chatml", "gpt-oss", "deepseek"), _chatml),
+    (("gemma",), _gemma),
+    (("phi-", "phi3", "phi4"), _phi),
+    (("mistral", "ministral", "mixtral"), _mistral),
+)
+
+
+def template_for(model_id: str):
+    lowered = (model_id or "").lower()
+    for keys, fn in _FAMILY_TEMPLATES:
+        if any(k in lowered for k in keys):
+            return fn
+    return _generic
+
+
+def render_chat(tokenizer, messages: Sequence[Mapping[str, str]],
+                model_id: str = "") -> str:
     apply = getattr(tokenizer, "apply_chat_template", None)
     if apply is not None:
         try:
-            return apply(list(messages), tokenize=False, add_generation_prompt=True)
+            return apply(list(messages), tokenize=False,
+                         add_generation_prompt=True)
         except Exception:
             pass
-    parts = []
-    for m in messages:
-        role = m.get("role", "user")
-        content = m.get("content", "")
-        parts.append(f"<|{role}|>\n{content}\n")
-    parts.append("<|assistant|>\n")
-    return "".join(parts)
+    return template_for(model_id)(list(messages))

@@ -228,7 +228,8 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                 messages = body.get("messages")
                 if not isinstance(messages, list) or not messages:
                     return self._error(400, "'messages' must be a non-empty list")
-                prompt_text = render_chat(st.engine.tokenizer, messages)
+                prompt_text = render_chat(st.engine.tokenizer, messages,
+                                          model_id=st.engine.md.name)
             else:
                 prompt = body.get("prompt", "")
                 if isinstance(prompt, list):

@@ -270,6 +270,35 @@ def _gpt_oss(layers, experts):
 _add("gpt-oss-20b", "openai/gpt-oss-20b", _gpt_oss(24, 32), quant="mxfp4")
 _add("gpt-oss-120b", "openai/gpt-oss-120b", _gpt_oss(36, 128), quant="mxfp4")
 
+# ---- additional current-generation presets (beyond the reference's 31) --
+_add("llama-3.2-1b-instruct", "meta-llama/Llama-3.2-1B-Instruct",
+     {**_llama(128256, 2048, 16, 32, 8, 8192), "tie_word_embeddings": True,
+      "head_dim": 64}, auth=True)
+_add("llama-3.2-3b-instruct", "meta-llama/Llama-3.2-3B-Instruct",
+     {**_llama(128256, 3072, 28, 24, 8, 8192), "tie_word_embeddings": True,
+      "head_dim": 128}, auth=True)
+
+
+def _qwen3(vocab, hidden, layers, heads, kv, inter, head_dim=128, max_pos=40960):
+    return {
+        "architectures": ["Qwen3ForCausalLM"],
+        "model_type": "qwen3",
+        "vocab_size": vocab,
+        "hidden_size": hidden,
+        "num_hidden_layers": layers,
+        "num_attention_heads": heads,
+        "num_key_value_heads": kv,
+        "head_dim": head_dim,
+        "intermediate_size": inter,
+        "max_position_embeddings": max_pos,
+        "rope_theta": 1000000.0,
+        "rms_norm_eps": 1e-6,
+    }
+
+
+_add("qwen3-8b", "Qwen/Qwen3-8B", _qwen3(151936, 4096, 36, 32, 8, 12288))
+_add("qwen3-32b", "Qwen/Qwen3-32B", _qwen3(151936, 5120, 64, 64, 8, 25600))
+
 # ---- tiny test model (not in the reference; for CI and smoke runs) -----
 _add("tiny-llama-test", "kaito-tpu/tiny-llama-test",
      _llama(2048, 256, 4, 8, 4, 1024, max_pos=2048, theta=10000.0, scaling=None),

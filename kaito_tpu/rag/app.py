@@ -54,6 +54,11 @@ class RAGService:
         from kaito_tpu.rag.vector_store import FlatDenseIndex
 
         engine = self.cfg.vector_db_engine
+        if engine == "qdrant" and self.cfg.vector_db_url:
+            from kaito_tpu.rag.qdrant_store import QdrantDenseIndex
+
+            url = self.cfg.vector_db_url
+            return lambda dim: QdrantDenseIndex(dim, url=url)
         if engine in ("native", "faiss"):
             try:
                 from kaito_tpu.native import NativeFlatIndex, load_native

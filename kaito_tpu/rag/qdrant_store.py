@@ -1,0 +1,100 @@
+"""Qdrant dense-index backend over its REST API.
+
+Parity with the reference's Qdrant store
+(``presets/ragengine/vector_store/qdrant_store.py``), minus the client
+library: a urllib REST client implementing the same dense-index surface
+as FlatDenseIndex/NativeFlatIndex (add/remove/search/state/load_state),
+so the hybrid retriever (BM25 fusion, metadata filters, persistence of
+documents) is shared with the other backends.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import urllib.parse
+import urllib.request
+import uuid
+from typing import Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+class QdrantDenseIndex:
+    def __init__(self, dim: int, url: str = "http://127.0.0.1:6333",
+                 collection: str = "kaito", api_key: str = ""):
+        self.dim = dim
+        self.base = url.rstrip("/")
+        self.collection = collection
+        self.api_key = api_key
+        self._doc_to_point: dict[str, str] = {}
+        self._point_to_doc: dict[str, str] = {}
+        self._ensure_collection()
+
+    # -- REST plumbing -------------------------------------------------
+
+    def _req(self, method: str, path: str, body: Optional[dict] = None) -> dict:
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self.base + path, data=data, method=method,
+            headers={"Content-Type": "application/json",
+                     **({"api-key": self.api_key} if self.api_key else {})})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return json.loads(r.read() or b"{}")
+
+    def _ensure_collection(self) -> None:
+        try:
+            self._req("PUT", f"/collections/{self.collection}", {
+                "vectors": {"size": self.dim, "distance": "Dot"}})
+        except urllib.error.HTTPError as e:
+            if e.code != 409:  # already exists
+                raise
+
+    # -- dense-index surface -------------------------------------------
+
+    def add(self, doc_id: str, vec: np.ndarray) -> None:
+        point_id = self._doc_to_point.get(doc_id) or str(uuid.uuid4())
+        self._doc_to_point[doc_id] = point_id
+        self._point_to_doc[point_id] = doc_id
+        self._req("PUT", f"/collections/{self.collection}/points", {
+            "points": [{"id": point_id,
+                        "vector": np.asarray(vec, np.float32).tolist(),
+                        "payload": {"doc_id": doc_id}}]})
+
+    def remove(self, doc_id: str) -> None:
+        point_id = self._doc_to_point.pop(doc_id, None)
+        if point_id is None:
+            return
+        self._point_to_doc.pop(point_id, None)
+        self._req("POST", f"/collections/{self.collection}/points/delete",
+                  {"points": [point_id]})
+
+    def search(self, query_vec: np.ndarray, top_k: int) -> list[tuple[str, float]]:
+        out = self._req("POST", f"/collections/{self.collection}/points/search", {
+            "vector": np.asarray(query_vec, np.float32).tolist(),
+            "limit": top_k, "with_payload": True})
+        hits = []
+        for r in out.get("result", []):
+            doc = (r.get("payload") or {}).get("doc_id") \
+                or self._point_to_doc.get(str(r.get("id")))
+            if doc:
+                hits.append((doc, float(r.get("score", 0.0))))
+        return hits
+
+    def state(self) -> dict:
+        """Documents persist through the python store; vectors live in
+        qdrant. Export ids only so persist/load keeps the id mapping."""
+        return {"ids": list(self._doc_to_point),
+                "vecs": np.zeros((0, self.dim), np.float32),
+                "qdrant_points": dict(self._doc_to_point)}
+
+    def load_state(self, state: dict) -> None:
+        if "qdrant_points" in state:
+            self._doc_to_point = dict(state["qdrant_points"])
+            self._point_to_doc = {v: k for k, v in self._doc_to_point.items()}
+            return
+        for doc_id, vec in zip(state.get("ids", []),
+                               np.asarray(state.get("vecs", []))):
+            self.add(str(doc_id), vec)

@@ -1,0 +1,98 @@
+"""Qdrant backend against an in-process fake implementing the REST
+subset (collection create, upsert, delete, search by dot product)."""
+
+import json
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from kaito_tpu.rag.embeddings import HashingEmbedder
+from kaito_tpu.rag.qdrant_store import QdrantDenseIndex
+from kaito_tpu.rag.vector_store import VectorIndex
+
+
+class FakeQdrant(BaseHTTPRequestHandler):
+    store: dict  # {collection: {point_id: (vector, payload)}}
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):
+        pass
+
+    def _json(self, code, obj):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self):
+        n = int(self.headers.get("Content-Length", 0))
+        return json.loads(self.rfile.read(n) or b"{}")
+
+    def do_PUT(self):
+        parts = self.path.strip("/").split("/")
+        if len(parts) == 2:            # create collection
+            self.store.setdefault(parts[1], {})
+            return self._json(200, {"result": True})
+        if len(parts) == 3 and parts[2] == "points":
+            col = self.store.setdefault(parts[1], {})
+            for p in self._body()["points"]:
+                col[str(p["id"])] = (p["vector"], p.get("payload", {}))
+            return self._json(200, {"result": {"status": "ok"}})
+        self._json(404, {})
+
+    def do_POST(self):
+        parts = self.path.strip("/").split("/")
+        col = self.store.get(parts[1], {})
+        if parts[-1] == "delete":
+            for pid in self._body()["points"]:
+                col.pop(str(pid), None)
+            return self._json(200, {"result": {}})
+        if parts[-1] == "search":
+            body = self._body()
+            q = np.asarray(body["vector"])
+            scored = [
+                {"id": pid, "score": float(np.dot(q, np.asarray(vec))),
+                 "payload": payload}
+                for pid, (vec, payload) in col.items()]
+            scored.sort(key=lambda r: -r["score"])
+            return self._json(200, {"result": scored[: body.get("limit", 10)]})
+        self._json(404, {})
+
+
+@pytest.fixture()
+def qdrant_url():
+    handler = type("H", (FakeQdrant,), {"store": {}})
+    server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{server.server_address[1]}"
+    server.shutdown()
+
+
+def test_qdrant_index_roundtrip(qdrant_url):
+    ix = QdrantDenseIndex(8, url=qdrant_url)
+    rng = np.random.RandomState(0)
+    vecs = {f"d{i}": rng.randn(8).astype(np.float32) for i in range(5)}
+    for d, v in vecs.items():
+        ix.add(d, v)
+    q = vecs["d3"]
+    hits = ix.search(q, 2)
+    assert hits[0][0] == "d3"
+    ix.remove("d3")
+    hits = ix.search(q, 2)
+    assert all(h[0] != "d3" for h in hits)
+
+
+def test_hybrid_store_with_qdrant_backend(qdrant_url):
+    emb = HashingEmbedder()
+    idx = VectorIndex(
+        "t", emb,
+        dense_factory=lambda dim: QdrantDenseIndex(dim, url=qdrant_url))
+    idx.add_documents(["paged attention stores kv cache in pages",
+                       "the mitochondria is the powerhouse of the cell"])
+    hits = idx.retrieve("kv cache pages", top_k=1)
+    assert "paged attention" in hits[0]["text"]
