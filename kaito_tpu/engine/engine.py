@@ -590,21 +590,36 @@ class InferenceEngine:
         table = np.zeros((self.pages_per_seq,), np.int32)
         table[:len(pages)] = pages
 
+        budget = max(self.cfg.max_prefill_tokens, self.cfg.page_size)
         if cached:
-            fn = self._prefill_ctx_fn(bucket)
-            self.cache, logits = fn(self.params, self.cache,
-                                    jnp.asarray(tokens),
-                                    jnp.asarray([m], np.int32),
-                                    jnp.asarray(table[None]),
-                                    jnp.asarray([cached], np.int32))
             self.counters["prefix_cached_tokens_total"] += cached
+        if m > budget or cached:
+            # chunked prefill: each chunk attends over the paged history
+            # (cached prefix + earlier chunks) — bounds per-step latency
+            # for long prompts (the feature vLLM gives the reference)
+            pos = cached
+            logits = None
+            while pos < n:
+                chunk = req.prompt_tokens[pos: pos + budget]
+                cm = len(chunk)
+                cbucket = self._bucket(cm)
+                ctoks = np.zeros((1, cbucket), np.int32)
+                ctoks[0, :cm] = chunk
+                fn = self._prefill_ctx_fn(cbucket)
+                self.cache, logits = fn(self.params, self.cache,
+                                        jnp.asarray(ctoks),
+                                        jnp.asarray([cm], np.int32),
+                                        jnp.asarray(table[None]),
+                                        jnp.asarray([pos], np.int32))
+                self.counters["prefill_steps_total"] += 1
+                pos += cm
         else:
             fn = self._prefill_fn(bucket)
             self.cache, logits = fn(self.params, self.cache,
                                     jnp.asarray(tokens),
                                     jnp.asarray([n], np.int32),
                                     jnp.asarray(table[None]))
-        self.counters["prefill_steps_total"] += 1
+            self.counters["prefill_steps_total"] += 1
         self.counters["prompt_tokens_total"] += n
 
         # first sampled token
