@@ -526,7 +526,11 @@ class TransformerLM:
 
     def _logits(self, params, x):
         head = params["embed"] if self.arch.tie_word_embeddings else params["lm_head"]
-        logits = x.astype(jnp.float32) @ head.astype(jnp.float32).T
+        # bf16 inputs with fp32 accumulation: upcasting bf16 weights to
+        # fp32 inputs adds no information but runs the MXU at fp32 rate
+        logits = jax.lax.dot_general(
+            x, head, (((x.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
         logits = nn.softcap(logits, self.arch.final_logit_softcap)
         return logits[..., : self.arch.vocab_size]
 
