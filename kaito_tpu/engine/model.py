@@ -381,6 +381,14 @@ class TransformerLM:
                     q, ck, cv, page_tables, start, true_lens,
                     scale=self._scale, sliding_window=window,
                     logit_softcap=a.attn_logit_softcap)
+            elif self.attn_impl == "pallas":
+                from kaito_tpu.engine.ops.flash_prefill import (
+                    flash_prefill_attention)
+
+                win = window if window is not None else jnp.int32(_BIG_WINDOW)
+                out = flash_prefill_attention(
+                    q, k_new, v_new, true_lens, jnp.asarray(win, jnp.int32),
+                    scale=self._scale, softcap=a.attn_logit_softcap)
             else:
                 out = attn.prefill_attention(
                     q, k_new, v_new, scale=self._scale,

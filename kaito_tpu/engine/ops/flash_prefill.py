@@ -1,0 +1,127 @@
+"""Pallas TPU kernel: flash attention for prefill chunks.
+
+Causal self-attention over a fresh chunk without materializing the
+[T, T] score matrix: the grid tiles (batch, q-head, q-block); K/V for
+the whole chunk sit in VMEM (chunks are bounded by the engine's
+prefill buckets, so T*D stays well under the VMEM budget) and the
+kernel walks K blocks with online softmax, skipping blocks entirely
+above the causal diagonal.
+
+Same contract as engine.attention.prefill_attention (GQA, true_len,
+sliding window, softcap); tests compare the two in interpreter mode.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+
+
+def _flash_kernel(
+    true_len_ref,      # [B] SMEM (scalar prefetch)
+    window_ref,        # [1] SMEM
+    q_ref,             # [1, Bq, 1, D] VMEM (pre-scaled)
+    k_ref,             # [1, T, 1, D] VMEM
+    v_ref,             # [1, T, 1, D] VMEM
+    o_ref,             # [1, Bq, 1, D] VMEM
+    *,
+    block_k: int,
+    softcap: Optional[float],
+):
+    b = pl.program_id(0)
+    qi = pl.program_id(2)
+    true_len = true_len_ref[b]
+    window = window_ref[0]
+
+    q = q_ref[0, :, 0, :]                    # [Bq, D]
+    Bq, D = q.shape
+    T = k_ref.shape[1]
+    q_start = qi * Bq
+    num_k_blocks = pl.cdiv(jnp.minimum(q_start + Bq, true_len), block_k)
+
+    q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (Bq, 1), 0)
+
+    def body(ki, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(ki * block_k, block_k), 0, :]   # [Bk, D]
+        v = v_ref[0, pl.ds(ki * block_k, block_k), 0, :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # [Bq, Bk]
+        if softcap:
+            s = jnp.tanh(s / softcap) * softcap
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1)
+        valid = (k_pos <= q_pos) & (k_pos < true_len) \
+            & (k_pos > q_pos - window)
+        s = jnp.where(valid, s, NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(p.astype(v.dtype), v,
+                                 (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        return m_new, l_new, acc * alpha + pv
+
+    m0 = jnp.full((Bq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((Bq, 1), jnp.float32)
+    acc0 = jnp.zeros((Bq, D), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, num_k_blocks, body, (m0, l0, acc0))
+    o_ref[0, :, 0, :] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "softcap", "block_q", "block_k", "interpret"))
+def flash_prefill_attention(
+    q: jax.Array,            # [B, T, H, D]
+    k: jax.Array,            # [B, T, Hkv, D]
+    v: jax.Array,
+    true_len: jax.Array,     # [B] int32
+    window: jax.Array,       # [] int32 (huge == global)
+    *,
+    scale: float,
+    softcap: Optional[float] = None,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: bool = False,
+) -> jax.Array:
+    B, T, H, D = q.shape
+    Hkv = k.shape[2]
+    G = H // Hkv
+    bq = min(block_q, T)
+    bk = min(block_k, T)
+    if T % bq or T % bk:
+        raise ValueError(f"chunk length {T} must be a multiple of the "
+                         f"block sizes ({bq}, {bk})")
+    grid = (B, H, T // bq)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, 1, D), lambda b, h, t, *_: (b, t, h, 0)),
+            pl.BlockSpec((1, T, 1, D), lambda b, h, t, *_: (b, 0, h // G, 0)),
+            pl.BlockSpec((1, T, 1, D), lambda b, h, t, *_: (b, 0, h // G, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, 1, D), lambda b, h, t, *_: (b, t, h, 0)),
+    )
+    kernel = functools.partial(_flash_kernel, block_k=bk, softcap=softcap)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, T, H, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(true_len, jnp.reshape(window, (1,)), (q * scale).astype(q.dtype), k, v)
