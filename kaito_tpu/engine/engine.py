@@ -133,6 +133,8 @@ class InferenceEngine:
         self.model = TransformerLM(
             arch, dtype=self.dtype,
             attn_impl="pallas" if use_pallas else "jax")
+        if arch.num_experts > 0:
+            self.model.moe_impl = "ragged"  # grouped-matmul serving path
         self.tokenizer = load_tokenizer(self.md.hf_id, arch.vocab_size)
         self.mesh = mesh if mesh is not None else self._build_mesh()
 

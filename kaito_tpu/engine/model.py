@@ -63,6 +63,7 @@ class TransformerLM:
         self.attn_impl = attn_impl  # "jax" | "pallas" (paged decode)
         self.lora_scaling = 0.0     # set by the tuner when lora keys exist
         self.ring = None            # (Mesh, axis) => sequence-parallel training
+        self.moe_impl = "dense"     # "dense" | "ragged" (grouped matmul)
         self.groups = _layer_groups(arch)
         self.vocab_padded = -(-arch.vocab_size // VOCAB_ALIGN) * VOCAB_ALIGN
         # rope tables are concrete constants; computing them lazily inside
@@ -341,7 +342,8 @@ class TransformerLM:
     def _mlp(self, x: jax.Array, p: dict, moe: bool) -> jax.Array:
         if moe:
             B, T, E = x.shape
-            y = nn.moe_mlp(x.reshape(B * T, E), p, self.arch)
+            fn = nn.moe_mlp_ragged if self.moe_impl == "ragged" else nn.moe_mlp
+            y = fn(x.reshape(B * T, E), p, self.arch)
             return y.reshape(B, T, E)
         return nn.mlp(x, p, self.arch, self.lora_scaling)
 

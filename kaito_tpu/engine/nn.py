@@ -184,6 +184,47 @@ def moe_mlp(x: jax.Array, p: dict, arch: ModelArch) -> jax.Array:
     return y
 
 
+def moe_mlp_ragged(x: jax.Array, p: dict, arch: ModelArch) -> jax.Array:
+    """Token-choice MoE via grouped (ragged) matmuls.
+
+    Tokens sort by assigned expert and each expert runs one matmul over
+    its contiguous group (``lax.ragged_dot`` — XLA's grouped-GEMM,
+    megablox-style on TPU).  FLOPs scale with top_k instead of the
+    expert count, unlike the dense fallback in :func:`moe_mlp`.
+    Serving-path implementation; training keeps the dense form.
+    """
+    T, E = x.shape
+    X = arch.num_experts
+    k = arch.num_experts_per_tok
+    logits = x.astype(jnp.float32) @ p["router"].astype(jnp.float32)
+    weights, idx = jax.lax.top_k(logits, k)            # [T, k]
+    weights = jax.nn.softmax(weights, axis=-1)
+
+    flat_expert = idx.reshape(-1)                      # [T*k]
+    order = jnp.argsort(flat_expert)                   # stable
+    token_of = order // k                              # originating token
+    x_sorted = x[token_of]                             # [T*k, E]
+    group_sizes = jnp.bincount(flat_expert, length=X)
+
+    gate = jax.lax.ragged_dot(x_sorted, p["experts_gate"], group_sizes,
+                              preferred_element_type=jnp.float32)
+    up = jax.lax.ragged_dot(x_sorted, p["experts_up"], group_sizes,
+                            preferred_element_type=jnp.float32)
+    h = (activation(gate, arch.hidden_act) * up).astype(x.dtype)
+    out_sorted = jax.lax.ragged_dot(h, p["experts_down"], group_sizes,
+                                    preferred_element_type=jnp.float32)
+
+    w_sorted = weights.reshape(-1)[order]
+    y = jnp.zeros((T, E), jnp.float32).at[token_of].add(
+        out_sorted * w_sorted[:, None])
+    y = y.astype(x.dtype)
+    if "shared_gate" in p:
+        shared = {"gate": p["shared_gate"], "up": p["shared_up"],
+                  "down": p["shared_down"]}
+        y = y + mlp(x, shared, arch)
+    return y
+
+
 def softcap(x: jax.Array, cap: Optional[float]) -> jax.Array:
     if not cap:
         return x
