@@ -1,0 +1,144 @@
+"""Pipeline-parallel training step (GPipe schedule under shard_map).
+
+The PP tier the planner emits for models beyond the largest slice
+(mesh ``pipeline`` axis over DCN).  Layers split into contiguous
+stages, one per pipeline rank; microbatches stream through the ring
+with ``ppermute`` hand-offs, so at steady state every stage computes a
+different microbatch — the classic GPipe schedule with M + P - 1 ticks.
+Stage 0 embeds, the last stage computes logits/loss; everything is
+differentiable (grads flow back through the permutes), so one
+``jax.grad`` over the wrapped loss trains the whole pipeline.
+
+Scope (v1): dense single-group models (no MoE/MLA), full-length packed
+batches; composes with the tensor axis via the model's own GSPMD
+shardings inside each stage.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from kaito_tpu.engine.model import TransformerLM
+from kaito_tpu.tuning.train_step import cross_entropy_loss
+
+
+def split_stage_params(model: TransformerLM, params: dict, num_stages: int) -> dict:
+    """Reshape the scanned layer stacks [L, ...] -> [P, L/P, ...] so the
+    leading axis shards over the pipeline mesh axis."""
+    (group,) = model.groups  # dense single group (v1 scope)
+    L = model.arch.num_layers
+    if L % num_stages:
+        raise ValueError(f"{L} layers do not split into {num_stages} stages")
+    out = dict(params)
+    out[group.name] = {
+        k: v.reshape((num_stages, L // num_stages) + v.shape[1:])
+        for k, v in params[group.name].items()}
+    return out
+
+
+def merge_stage_params(model: TransformerLM, params: dict) -> dict:
+    (group,) = model.groups
+    out = dict(params)
+    out[group.name] = {
+        k: v.reshape((-1,) + v.shape[2:]) for k, v in params[group.name].items()}
+    return out
+
+
+def _stage_apply(model: TransformerLM, stack: dict, x: jax.Array) -> jax.Array:
+    """Run this stage's layers over activations [mb, T, E]."""
+    T = x.shape[1]
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), x.shape[:2])
+    true_lens = jnp.full((x.shape[0],), T, jnp.int32)
+
+    def body(h, p):
+        h = model._layer_train(h, p, None, False, positions=positions,
+                               true_lens=true_lens)
+        return h, None
+
+    x, _ = jax.lax.scan(body, x, stack)
+    return x
+
+
+def pipeline_loss_fn(model: TransformerLM, mesh: Mesh, num_microbatches: int,
+                     axis: str = "pipeline"):
+    """Build loss(params_staged, batch) running the GPipe schedule."""
+    num_stages = mesh.shape[axis]
+    (group,) = model.groups
+
+    def local_loss(stage_stack, embed, final_norm, head, tokens, mask):
+        # inside shard_map: stage_stack [1, L/P, ...]
+        p_idx = jax.lax.axis_index(axis)
+        stack = jax.tree.map(lambda v: v[0], stage_stack)
+        M = num_microbatches
+        B = tokens.shape[0]
+        mb = B // M
+        inputs = tokens[:, :-1].reshape(M, mb, -1)
+        targets = tokens[:, 1:].reshape(M, mb, -1)
+        masks = mask.reshape(M, mb, -1)
+        T = inputs.shape[-1]
+        E = model.arch.hidden_size
+
+        fwd_perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+
+        def tick(carry, t):
+            recv, loss_acc, denom_acc = carry
+            mb_here = t - p_idx                  # microbatch this stage sees
+            valid = (mb_here >= 0) & (mb_here < M)
+            mb_idx = jnp.clip(mb_here, 0, M - 1)
+
+            x_in = jnp.where(
+                p_idx == 0,
+                model._embed({"embed": embed}, inputs[mb_idx]),
+                recv)
+            x_out = _stage_apply(model, stack, x_in)
+
+            # last stage: loss for its microbatch
+            def final(x):
+                h = model._norm(x, {"final_norm": final_norm}, "final_norm")
+                logits = model._logits({"embed": head, "lm_head": head}, h)
+                m = masks[mb_idx]
+                lp = jax.nn.log_softmax(logits, axis=-1)
+                nll = -jnp.take_along_axis(
+                    lp, targets[mb_idx][..., None], axis=-1)[..., 0]
+                return jnp.sum(nll * m), jnp.sum(m)
+
+            l_num, l_den = final(x_out)
+            is_last = p_idx == num_stages - 1
+            use = valid & is_last
+            loss_acc = loss_acc + jnp.where(use, l_num, 0.0)
+            denom_acc = denom_acc + jnp.where(use, l_den, 0.0)
+
+            sent = jax.lax.ppermute(x_out, axis, fwd_perm)
+            return (sent, loss_acc, denom_acc), None
+
+        recv0 = jnp.zeros((mb, T, E), model.dtype)
+        (recv, loss_sum, denom), _ = jax.lax.scan(
+            tick, (recv0, jnp.float32(0.0), jnp.float32(0.0)),
+            jnp.arange(num_stages + M - 1))
+        # only the last stage holds the loss; share it with everyone
+        loss_sum = jax.lax.psum(loss_sum, axis)
+        denom = jax.lax.psum(denom, axis)
+        return loss_sum / jnp.maximum(denom, 1.0)
+
+    sharded = jax.shard_map(
+        local_loss,
+        mesh=mesh,
+        in_specs=(P(axis), P(), P(), P(), P(), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+
+    def loss(params_staged, batch):
+        stage_stack = params_staged[group.name]
+        head = params_staged.get("lm_head", params_staged["embed"])
+        return sharded(stage_stack, params_staged["embed"],
+                       params_staged["final_norm"], head,
+                       batch["tokens"], batch["mask"])
+
+    return loss

@@ -94,6 +94,11 @@ def test_metrics_counters(engine):
     assert c["requests_finished_total"] >= 8
     assert c["generation_tokens_total"] > 0
     assert c["prompt_tokens_total"] > 0
-    # all pages returned after the burst
-    time.sleep(0.1)
+    # all pages returned after the burst (release happens just after the
+    # stream's end marker — poll briefly instead of racing it)
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if engine.allocator.available == engine.allocator.num_pages - 1:
+            break
+        time.sleep(0.05)
     assert engine.allocator.available == engine.allocator.num_pages - 1
