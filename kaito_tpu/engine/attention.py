@@ -142,6 +142,54 @@ def mla_prefill_attention(
     return jnp.einsum("bhts,bshd->bthd", p.astype(v.dtype), v)
 
 
+def mla_paged_context_attention(
+    q_nope: jax.Array,        # [B, T, H, dn] chunk queries
+    q_rope: jax.Array,        # [B, T, H, dr] (roped)
+    cache_latent: jax.Array,  # [P, 1, ps, dl+dr] (chunk latent already written)
+    page_tables: jax.Array,   # [B, pmax]
+    start_pos: jax.Array,     # [B] absolute position of q[:, 0]
+    true_lens: jax.Array,     # [B] valid NEW tokens in the chunk
+    kv_b_k: jax.Array,        # [dl, H*dn]
+    kv_b_v: jax.Array,        # [dl, H*dv]
+    *,
+    scale: float,
+    kv_lora_rank: int,
+) -> jax.Array:
+    """Chunked MLA prefill WITH prior context: chunk queries attend over
+    the whole paged latent history (earlier chunks + this one) with
+    absolute-position causal masking — the latent analogue of
+    paged_context_attention.  Uses the absorption form so per-token K/V
+    are never materialized."""
+    B, T, H, dn = q_nope.shape
+    _, _, ps, dtot = cache_latent.shape
+    dl = kv_lora_rank
+    pmax = page_tables.shape[1]
+    S = pmax * ps
+    dv = kv_b_v.shape[1] // H
+
+    lat = cache_latent[page_tables][:, :, 0]       # [B, pmax, ps, dl+dr]
+    lat = lat.reshape(B, S, dtot)
+    c_kv, k_rope = lat[..., :dl], lat[..., dl:]
+
+    wk = kv_b_k.reshape(dl, H, dn)
+    q_lat = jnp.einsum("bthd,lhd->bthl", q_nope, wk,
+                       preferred_element_type=jnp.float32)  # [B, T, H, dl]
+    s = jnp.einsum("bthl,bsl->bhts", q_lat, c_kv.astype(jnp.float32))
+    s = s + jnp.einsum("bthd,bsd->bhts", q_rope.astype(jnp.float32),
+                       k_rope.astype(jnp.float32))
+    s = s * scale
+    q_pos = start_pos[:, None] + jnp.arange(T)[None, :]       # [B, T]
+    k_pos = jnp.arange(S)[None, :]                            # [1, S]
+    mask = k_pos[:, None, :] <= q_pos[:, :, None]             # [B, T, S]
+    mask &= (k_pos < (start_pos + true_lens)[:, None])[:, None, :]
+    s = jnp.where(mask[:, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out_lat = jnp.einsum("bhts,bsl->bthl", p, c_kv.astype(jnp.float32))
+    wv = kv_b_v.reshape(dl, H, dv)
+    out = jnp.einsum("bthl,lhd->bthd", out_lat, wv.astype(jnp.float32))
+    return out.astype(q_nope.dtype)
+
+
 def mla_paged_decode_attention(
     q_nope: jax.Array,       # [B, H, dn]
     q_rope: jax.Array,       # [B, H, dr]

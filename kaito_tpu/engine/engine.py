@@ -129,7 +129,9 @@ class InferenceEngine:
         self.md = metadata or get_model_by_name(cfg.model)
         arch = self.md.arch
         self.dtype = jnp.dtype(cfg.dtype)
-        use_pallas = bool(cfg.use_pallas)  # default off until TPU-validated
+        # None = auto: Pallas kernels on TPU, pure-JAX elsewhere
+        use_pallas = (jax.default_backend() == "tpu"
+                      if cfg.use_pallas is None else bool(cfg.use_pallas))
         self.model = TransformerLM(
             arch, dtype=self.dtype,
             attn_impl="pallas" if use_pallas else "jax")
@@ -442,13 +444,22 @@ class InferenceEngine:
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
 
-    def _release_pages(self, req: Request, pages: list[int]):
+    def _release_pages(self, req: Request, pages: list[int],
+                       commit: bool = True):
         if self.prefix_cache is not None:
-            # imported-KV pages are never committed (token list unknown
-            # to be trustworthy); everything else feeds the radix tree
-            tokens = [] if req.kv_import is not None else \
-                list(req.prompt_tokens) + list(req.output_tokens)
-            self.prefix_cache.release(tokens, pages)
+            if not commit or req.kv_import is not None:
+                # failure paths (KV may be partially written) and
+                # imported-KV pages (foreign bytes) never commit
+                tokens = [] if req.kv_import is not None else \
+                    list(req.prompt_tokens)
+                self.prefix_cache.release_uncommitted(tokens, pages)
+                return
+            # commit only tokens whose KV was actually written: the final
+            # sampled token's KV never lands (the slot retires before the
+            # next decode step would write it), so committing it would let
+            # a later prefix hit attend over a garbage page slot
+            written = list(req.prompt_tokens) + list(req.output_tokens[:-1])
+            self.prefix_cache.release(written, pages)
         else:
             self.allocator.release(pages)
 
@@ -461,7 +472,7 @@ class InferenceEngine:
         for i, slot in enumerate(self.slots):
             if slot.request is not None:
                 self._fail_request(slot.request)
-                self._release_pages(slot.request, slot.pages)
+                self._release_pages(slot.request, slot.pages, commit=False)
                 slot.request, slot.pages = None, []
                 self.active[i] = False
 
@@ -562,7 +573,11 @@ class InferenceEngine:
             try:
                 return self._admit_with_pages(req, free_slot, pages, cached)
             except Exception:
-                self.prefix_cache.release(list(req.prompt_tokens), pages)
+                # prefill may not have finished writing these pages:
+                # return them WITHOUT committing into the radix tree,
+                # matching the token list the acquire was made with
+                self.prefix_cache.release_uncommitted(
+                    list(acquire_tokens), pages)
                 raise
         pages_needed = -(-max_total // self.cfg.page_size)
         if pages_needed > self.allocator.available:

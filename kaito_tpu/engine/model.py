@@ -256,7 +256,7 @@ class TransformerLM:
     # ------------------------------------------------------------------
 
     def _mla_attention(self, h, p, ck, cv, mode, *, positions, page_tables,
-                       lengths, true_lens, active):
+                       lengths, true_lens, active, start_pos=None):
         """Latent attention: project to a shared compressed KV latent,
         cache only [c_kv ; k_rope], expand per-head K/V on use (prefill)
         or absorb projections into the query (decode)."""
@@ -288,12 +288,21 @@ class TransformerLM:
                 scale=self._scale, true_len=true_lens)
         elif mode == "prefill":
             ps = ck.shape[-2]
-            start = jnp.zeros((B,), jnp.int32)
+            start = (start_pos if start_pos is not None
+                     else jnp.zeros((B,), jnp.int32))
             ck = write_prefill_tokens(ck, latent[:, :, None, :], page_tables,
                                       start, true_lens, ps)
-            out = attn.mla_prefill_attention(
-                q_nope, q_rope, c_kv, k_rope, p["kv_b_k"], p["kv_b_v"],
-                scale=self._scale, true_len=true_lens)
+            if start_pos is not None:
+                # chunked prefill: attend over the paged latent history
+                # (earlier chunks) + this chunk, absolute positions
+                out = attn.mla_paged_context_attention(
+                    q_nope, q_rope, ck, page_tables, start, true_lens,
+                    p["kv_b_k"], p["kv_b_v"], scale=self._scale,
+                    kv_lora_rank=dl)
+            else:
+                out = attn.mla_prefill_attention(
+                    q_nope, q_rope, c_kv, k_rope, p["kv_b_k"], p["kv_b_v"],
+                    scale=self._scale, true_len=true_lens)
         else:
             ps = ck.shape[-2]
             ck = write_decode_tokens(ck, latent[:, 0][:, None, :], page_tables,
@@ -363,7 +372,7 @@ class TransformerLM:
             attn_out, ck, cv = self._mla_attention(
                 h, p, ck, cv, mode, positions=positions,
                 page_tables=page_tables, lengths=lengths,
-                true_lens=true_lens, active=active)
+                true_lens=true_lens, active=active, start_pos=start_pos)
             if a.parallel_residual:
                 return x + attn_out + self._mlp(h, p, moe), ck, cv
             x = x + attn_out

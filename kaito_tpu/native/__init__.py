@@ -31,22 +31,23 @@ def load_native() -> Optional[ctypes.CDLL]:
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_LIB_PATH) and not _build_attempted:
+        if not _build_attempted:
+            # always invoke make: dependency-driven, a no-op when fresh,
+            # and it rebuilds a stale .so missing newer symbols
             _build_attempted = True
             try:
                 subprocess.run(["make", "-C", _DIR, "-s"], check=True,
                                capture_output=True, timeout=120)
             except Exception as e:
                 logger.warning("native build failed (%s); using python fallbacks", e)
-                return None
         if not os.path.exists(_LIB_PATH):
             return None
         try:
             lib = ctypes.CDLL(_LIB_PATH)
-        except OSError as e:
+            _declare(lib)
+        except (OSError, AttributeError) as e:
             logger.warning("cannot load %s: %s", _LIB_PATH, e)
             return None
-        _declare(lib)
         _lib = lib
         return _lib
 
@@ -61,6 +62,9 @@ def _declare(lib: ctypes.CDLL) -> None:
         c.c_void_p, c.POINTER(c.c_int32), c.c_int32, c.c_int32,
         c.POINTER(c.c_int32), c.POINTER(c.c_int32)]
     lib.kprefix_release.argtypes = [
+        c.c_void_p, c.POINTER(c.c_int32), c.c_int32,
+        c.POINTER(c.c_int32), c.c_int32]
+    lib.kprefix_release_uncommitted.argtypes = [
         c.c_void_p, c.POINTER(c.c_int32), c.c_int32,
         c.POINTER(c.c_int32), c.c_int32]
     lib.kprefix_available.restype = c.c_int32
@@ -120,6 +124,14 @@ class NativePrefixCache:
         pg = np.asarray(pages, np.int32)
         self._lib.kprefix_release(self._h, _i32ptr(toks), len(toks),
                                   _i32ptr(pg), len(pg))
+
+    def release_uncommitted(self, tokens: list[int], pages: list[int]) -> None:
+        """Return shared refs and free exclusive pages WITHOUT committing
+        anything into the radix tree (failure / unvalidated-KV paths)."""
+        toks = np.asarray(tokens, np.int32)
+        pg = np.asarray(pages, np.int32)
+        self._lib.kprefix_release_uncommitted(
+            self._h, _i32ptr(toks), len(toks), _i32ptr(pg), len(pg))
 
     @property
     def available(self) -> int:

@@ -198,6 +198,39 @@ void kprefix_release(void* handle, const int32_t* tokens, int32_t n_tokens,
   }
 }
 
+// Release WITHOUT committing: return shared refs (the contiguous prefix
+// of pages that matched committed nodes at acquire time) and free the
+// rest, entering nothing new into the tree.  Used for failure paths
+// where the pages' KV content was never fully written/validated, so
+// committing them would poison future prefix hits.
+void kprefix_release_uncommitted(void* handle, const int32_t* tokens,
+                                 int32_t n_tokens, const int32_t* pages,
+                                 int32_t n_pages) {
+  auto* c = static_cast<PrefixCache*>(handle);
+  std::lock_guard<std::mutex> lock(c->mu);
+  const int32_t ps = c->page_size;
+  const int32_t full_pages = std::min(n_tokens / ps, n_pages);
+  c->tick++;
+  u64 parent = 0;
+  bool matching = true;
+  for (int32_t i = 0; i < n_pages; i++) {
+    int32_t page = pages[i];
+    if (matching && i < full_pages) {
+      u64 key = hash_chunk(tokens + i * ps, ps, parent);
+      auto it = c->nodes.find(key);
+      if (it != c->nodes.end() && it->second.page == page) {
+        it->second.refcount--;
+        it->second.lru = c->tick;
+        parent = key;
+        continue;
+      }
+      matching = false;
+    }
+    if (c->page_owner.find(page) == c->page_owner.end())
+      c->free_pages.push_back(page);
+  }
+}
+
 int32_t kprefix_available(void* handle) {
   auto* c = static_cast<PrefixCache*>(handle);
   std::lock_guard<std::mutex> lock(c->mu);

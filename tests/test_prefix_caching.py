@@ -1,6 +1,8 @@
 """Automatic prefix caching through the engine: repeated prompts skip
 cached prefill compute and still decode identically."""
 
+import time
+
 import numpy as np
 import pytest
 
@@ -40,6 +42,52 @@ def test_prefix_reuse_identical_output():
         assert got_other == ref_other
     finally:
         plain.stop(); cached.stop()
+
+
+def test_final_sampled_token_never_committed():
+    """The last sampled token's KV is never written (the slot retires
+    first), so a sequence whose prompt+output ends exactly on a page
+    boundary must NOT commit that final page (ADVICE r1: committing it
+    let later prefix hits attend over a garbage slot)."""
+    eng = InferenceEngine(EngineConfig(**BASE))
+    plain = InferenceEngine(EngineConfig(**BASE, enable_prefix_caching=False))
+    p = SamplingParams(max_tokens=5, temperature=0.0, ignore_eos=True)
+    prompt = list(range(100, 127))        # 27 + 5 outputs = 32 = 2 pages
+    eng.start(); plain.start()
+    try:
+        out = list(eng.submit(prompt, p).stream())
+        assert len(out) == 5
+        # stream-end slightly precedes the release; wait for the commit
+        deadline = time.monotonic() + 5
+        while eng.prefix_cache.stats()["cached_pages"] == 0 \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        # written KV covers 31 tokens -> only ONE full page is cacheable
+        assert eng.prefix_cache.stats()["cached_pages"] == 1
+        # a request continuing the full 32-token sequence decodes the
+        # same as a cache-free engine (no garbage-KV attention)
+        cont = prompt + out
+        ref = list(plain.submit(cont, p).stream())
+        got = list(eng.submit(cont, p).stream())
+        assert got == ref
+    finally:
+        eng.stop(); plain.stop()
+
+
+def test_release_uncommitted_returns_pages_without_caching():
+    from kaito_tpu.native import NativePrefixCache
+
+    pc = NativePrefixCache(16, 4)
+    # seed one committed page
+    pc.release(list(range(4)), pc.acquire(list(range(4)), 4)[0])
+    assert pc.stats()["cached_pages"] == 1
+    avail = pc.available
+    toks = list(range(4)) + [9, 9, 9, 9]      # shared page + fresh page
+    pages, cached = pc.acquire(toks, 8)
+    assert cached == 4
+    pc.release_uncommitted(toks, pages)
+    assert pc.stats()["cached_pages"] == 1    # nothing new committed
+    assert pc.available == avail              # all refs/pages returned
 
 
 def test_pages_reclaimable_after_burst():
