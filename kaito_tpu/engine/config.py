@@ -21,7 +21,8 @@ class EngineConfig:
     page_size: int = 64                  # KV tokens per page
     max_num_seqs: int = 8                # concurrent decode slots
     max_pages: int = 0                   # 0 = derive from HBM budget
-    max_prefill_tokens: int = 1024       # prefill chunk budget per step
+    max_prefill_tokens: int = 512        # prefill chunk budget per step
+    prefill_interleave: int = 2          # decode steps between prefill chunks
     prefill_buckets: tuple[int, ...] = (128, 256, 512, 1024, 2048, 4096)
     dtype: str = "bfloat16"
     kv_dtype: str = "bfloat16"

@@ -6,19 +6,24 @@ step loop the reference leans on (SURVEY.md §3.1 "HOT LOOP").  Design:
 - Fixed decode *slots* (``max_num_seqs``).  One compiled decode step
   advances every slot each iteration; inactive slots write to the null
   page and their samples are discarded.  Static shapes, one program.
-- Prefill runs per admitted request, padded to a bucket length, writing
-  straight into the request's pages (no copy into the decode state —
-  the page table IS the hand-off).
-- Pages come from a free-list allocator; a request is admitted only
-  when its worst-case page need (prompt + max_tokens) is available, so
-  there is no mid-flight preemption in round 1.
+- Prefill runs in bounded chunks that interleave with decode at a
+  configurable ratio (decode-priority: running batches keep their
+  cadence while new prompts stream in), writing straight into the
+  request's pages (no copy into the decode state — the page table IS
+  the hand-off).  Admission is bookkeeping-only and fills every free
+  slot per step.
+- Pages come from a free-list allocator on demand: admission reserves
+  only the prompt's pages; decode grows a sequence page-by-page and,
+  when the pool is exhausted, preempts the newest sequence back to the
+  queue (its generated tokens become part of the prompt on resume, so
+  clients never see a discontinuity).
 - jit with donated cache/state keeps HBM traffic at the theoretical
   minimum; per-bucket programs are compiled on first use and cached.
 """
 
 from __future__ import annotations
 
-import itertools
+import collections
 import logging
 import queue
 import threading
@@ -69,6 +74,13 @@ class Request:
     finish_time: Optional[float] = None
     finish_reason: str = ""
     aborted: bool = False
+    preemptions: int = 0
+    prompt_counted: bool = False   # metrics: prompt tokens counted once
+
+    def resume_tokens(self) -> list[int]:
+        """Prompt plus everything generated so far — what a preempted
+        request prefills from on re-admission."""
+        return list(self.prompt_tokens) + list(self.output_tokens)
 
     def stream(self):
         """Yield token ids until completion."""
@@ -113,6 +125,15 @@ class _Slot:
     pages: list[int] = field(default_factory=list)
     position: int = 0          # next token position (== current length)
     remaining: int = 0
+    prefilling: bool = False
+    prefill_pos: int = 0       # prompt tokens written so far (incl. cached)
+    prefill_tokens: list[int] = field(default_factory=list)
+    seq: int = 0               # admission order (newest preempts first)
+
+    @property
+    def written(self) -> int:
+        """Tokens whose KV has actually landed in the cache."""
+        return self.prefill_pos if self.prefilling else self.position
 
 
 class InferenceEngine:
@@ -178,6 +199,9 @@ class InferenceEngine:
         # the prefix cache subsumes the free-list (same available/num_pages
         # surface for metrics)
         self.allocator = self.prefix_cache or PageAllocator(num_pages)
+        # a single sequence can never outgrow the whole pool (generation
+        # is length-capped so the preempt-self path always terminates)
+        self._capacity_tokens = (num_pages - 1) * cfg.page_size
         S = cfg.max_num_seqs
         self.slots = [_Slot() for _ in range(S)]
         self.page_tables = np.zeros((S, self.pages_per_seq), np.int32)
@@ -186,12 +210,15 @@ class InferenceEngine:
         self.sampling = SamplingState.create(S, cfg.seed)
         self.last_tokens = np.zeros((S,), np.int32)
 
-        self.waiting: "queue.SimpleQueue[Request]" = queue.SimpleQueue()
+        self.waiting: "collections.deque[Request]" = collections.deque()
         self._waiting_count = 0
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._tick = 0
+        self._prefill_rr = 0
+        self._admit_seq = 0
 
         # metrics (scraped by the server's /metrics)
         self.counters = {
@@ -202,6 +229,7 @@ class InferenceEngine:
             "prefill_steps_total": 0,
             "decode_steps_total": 0,
             "prefix_cached_tokens_total": 0,
+            "preemptions_total": 0,
         }
 
         self._decode_fn = self._build_decode_fn()
@@ -373,6 +401,10 @@ class InferenceEngine:
             raise ValueError(
                 f"prompt length {len(prompt_tokens)} exceeds max_model_len "
                 f"{self.cfg.max_model_len}")
+        if len(prompt_tokens) + 1 > self._capacity_tokens:
+            raise ValueError(
+                f"prompt length {len(prompt_tokens)} exceeds KV pool "
+                f"capacity {self._capacity_tokens} tokens")
         if params.max_tokens < 1:
             raise ValueError(f"max_tokens must be >= 1, got {params.max_tokens}")
         req = Request(req_id or f"req-{self.counters['requests_total']}",
@@ -380,7 +412,7 @@ class InferenceEngine:
         with self._lock:
             self.counters["requests_total"] += 1
             self._waiting_count += 1
-        self.waiting.put(req)
+            self.waiting.append(req)
         self._wake.set()
         return req
 
@@ -404,7 +436,7 @@ class InferenceEngine:
         with self._lock:
             self.counters["requests_total"] += 1
             self._waiting_count += 1
-        self.waiting.put(req)
+            self.waiting.append(req)
         self._wake.set()
         return req
 
@@ -444,24 +476,48 @@ class InferenceEngine:
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
 
-    def _release_pages(self, req: Request, pages: list[int],
-                       commit: bool = True):
+    def _pop_waiting(self) -> Optional[Request]:
+        with self._lock:
+            if not self.waiting:
+                return None
+            self._waiting_count -= 1
+            return self.waiting.popleft()
+
+    def _requeue_front(self, req: Request):
+        with self._lock:
+            self._waiting_count += 1
+            self.waiting.appendleft(req)
+
+    def _evict_slot(self, slot_idx: int, commit: bool = True):
+        """Return a slot's pages to the pool and clear it.
+
+        ``commit`` feeds the written-token prefix into the radix tree
+        for future prefix hits; failure paths pass False because their
+        page contents may be partially written.  Only tokens whose KV
+        actually landed are ever committed: the final sampled token's
+        KV never lands (the slot retires before the next decode step
+        would write it), so committing it would let a later prefix hit
+        attend over a garbage page slot.
+        """
+        slot = self.slots[slot_idx]
+        req = slot.request
         if self.prefix_cache is not None:
-            if not commit or req.kv_import is not None:
-                # failure paths (KV may be partially written) and
-                # imported-KV pages (foreign bytes) never commit
-                tokens = [] if req.kv_import is not None else \
-                    list(req.prompt_tokens)
-                self.prefix_cache.release_uncommitted(tokens, pages)
-                return
-            # commit only tokens whose KV was actually written: the final
-            # sampled token's KV never lands (the slot retires before the
-            # next decode step would write it), so committing it would let
-            # a later prefix hit attend over a garbage page slot
-            written = list(req.prompt_tokens) + list(req.output_tokens[:-1])
-            self.prefix_cache.release(written, pages)
+            tokens = [] if req.kv_import is not None else \
+                req.resume_tokens()[:slot.written]
+            if commit and req.kv_import is None:
+                self.prefix_cache.release(tokens, slot.pages)
+            else:
+                self.prefix_cache.release_uncommitted(tokens, slot.pages)
         else:
-            self.allocator.release(pages)
+            self.allocator.release(slot.pages)
+        slot.request = None
+        slot.pages = []
+        slot.prefilling = False
+        slot.prefill_tokens = []
+        slot.prefill_pos = 0
+        slot.position = 0
+        slot.remaining = 0
+        self.active[slot_idx] = False
 
     def _fail_request(self, req: Request):
         req.finish_reason = "error"
@@ -471,20 +527,16 @@ class InferenceEngine:
     def _fail_active_slots(self):
         for i, slot in enumerate(self.slots):
             if slot.request is not None:
-                self._fail_request(slot.request)
-                self._release_pages(slot.request, slot.pages, commit=False)
-                slot.request, slot.pages = None, []
-                self.active[i] = False
+                req = slot.request
+                self._evict_slot(i, commit=False)
+                self._fail_request(req)
 
     def _fail_all(self):
         self._fail_active_slots()
         while True:
-            try:
-                req = self.waiting.get_nowait()
-            except queue.Empty:
+            req = self._pop_waiting()
+            if req is None:
                 break
-            with self._lock:
-                self._waiting_count -= 1
             self._fail_request(req)
         self._recover_cache_if_poisoned()
 
@@ -520,189 +572,293 @@ class InferenceEngine:
                                      v=jax.device_put(self.cache.v, sh))
 
     def step(self) -> bool:
-        """One scheduler iteration. Returns False when idle."""
-        admitted = self._try_admit()
-        if self.active.any():
-            self._decode_once()
-            return True
-        return admitted
+        """One scheduler iteration. Returns False when idle.
 
-    def _try_admit(self) -> bool:
-        """Admit at most one waiting request into a free slot (prefill)."""
-        free_slot = next((i for i, s in enumerate(self.slots) if s.request is None), None)
-        if free_slot is None:
-            return False
-        try:
-            req = self.waiting.get_nowait()
-        except queue.Empty:
-            return False
-        with self._lock:
-            self._waiting_count -= 1
-        if req.aborted:
-            req.out.put(None)
-            return True
-        try:
-            return self._admit(req, free_slot)
-        except Exception:
-            # fail THIS request; the loop (and other requests) live on
-            # unless the cache was donated into the failed step
-            logger.exception("admission failed for %s", req.req_id)
-            self._fail_request(req)
-            self._recover_cache_if_poisoned()
-            return True
+        Decode-priority scheduling: every iteration with active slots
+        runs one decode step; prefill advances one bounded chunk every
+        ``prefill_interleave`` iterations (every iteration when nothing
+        is decoding), so a running batch keeps its token cadence while
+        new prompts stream in.
+        """
+        # ensure BEFORE admitting: growth of running sequences must not
+        # be starved by a fresh admission grabbing the last pages (which
+        # would be preempted right back — wasted churn)
+        if self.active.any():
+            self._ensure_decode_pages()
+        did = self._admit_new()
+        decoding = bool(self.active.any())
+        if decoding:
+            self._decode_once()
+            did = True
+        self._tick += 1
+        if (not decoding) or self.cfg.prefill_interleave <= 1 \
+                or self._tick % self.cfg.prefill_interleave == 0:
+            did = self._advance_prefills() or did
+        return did
+
+    def _admit_new(self) -> bool:
+        """Fill every free slot from the waiting queue (bookkeeping
+        only — prefill compute happens in _advance_prefills)."""
+        admitted = False
+        while True:
+            free_slot = next((i for i, s in enumerate(self.slots)
+                              if s.request is None), None)
+            if free_slot is None:
+                return admitted
+            req = self._pop_waiting()
+            if req is None:
+                return admitted
+            if req.aborted:
+                req.out.put(None)
+                admitted = True
+                continue
+            try:
+                if not self._admit(req, free_slot):
+                    return admitted      # page OOM: requeued, stall admission
+            except Exception:
+                # fail THIS request; the loop (and other requests) live on
+                # unless the cache was donated into the failed step
+                logger.exception("admission failed for %s", req.req_id)
+                self._fail_request(req)
+                self._recover_cache_if_poisoned()
+            admitted = True
 
     def _admit(self, req: Request, free_slot: int) -> bool:
-        n = len(req.prompt_tokens)
-        max_total = min(n + req.params.max_tokens, self.cfg.max_model_len)
+        """Reserve prompt pages and stage the request into a slot.
+
+        Reserve-on-demand: only the prompt (plus one decode token) is
+        reserved here; decode grows the page list page-by-page, with
+        preemption when the pool runs dry.
+        """
+        tokens = req.resume_tokens()
+        n = len(tokens)
+        cached = 0
+        # leave one page of headroom per decoding slot so admissions
+        # don't trigger immediate grow-preempt churn
+        headroom = sum(1 for i, s in enumerate(self.slots)
+                       if s.request is not None and self.active[i])
+        if self.allocator.available < -(-(n + 1) // self.cfg.page_size) + headroom:
+            self._requeue_front(req)
+            return False
         if self.prefix_cache is not None:
             # PD imports carry foreign KV bytes: acquire EXCLUSIVE pages
             # (empty-token acquire shares nothing) so a transfer can
             # neither overwrite shared pages nor commit into the tree
-            acquire_tokens = [] if req.kv_import is not None \
-                else req.prompt_tokens
-            res = self.prefix_cache.acquire(acquire_tokens, max_total)
+            acquire_tokens = [] if req.kv_import is not None else tokens
+            res = self.prefix_cache.acquire(acquire_tokens, n + 1)
             if res is None:
-                self.waiting.put(req)
-                with self._lock:
-                    self._waiting_count += 1
+                self._requeue_front(req)
                 return False
             pages, cached = res
             # at least one suffix token must run to produce logits; the
             # overlap rewrites identical KV into the shared page
             cached = min(cached, n - 1)
-            try:
-                return self._admit_with_pages(req, free_slot, pages, cached)
-            except Exception:
-                # prefill may not have finished writing these pages:
-                # return them WITHOUT committing into the radix tree,
-                # matching the token list the acquire was made with
-                self.prefix_cache.release_uncommitted(
-                    list(acquire_tokens), pages)
-                raise
-        pages_needed = -(-max_total // self.cfg.page_size)
-        if pages_needed > self.allocator.available:
-            # not enough KV memory: requeue and stall admission
-            self.waiting.put(req)
-            with self._lock:
-                self._waiting_count += 1
-            return False
-
-        pages = self.allocator.alloc(pages_needed)
-        try:
-            return self._admit_with_pages(req, free_slot, pages)
-        except Exception:
-            self.allocator.release(pages)
-            raise
-
-    def _admit_with_pages(self, req: Request, free_slot: int,
-                          pages: list[int], cached: int = 0) -> bool:
-        if req.kv_import is not None:
-            return self._admit_imported(req, free_slot, pages)
-        n = len(req.prompt_tokens)
-        suffix = req.prompt_tokens[cached:]
-        m = len(suffix)
-        bucket = self._bucket(m)
-        tokens = np.zeros((1, bucket), np.int32)
-        tokens[0, :m] = suffix
-        table = np.zeros((self.pages_per_seq,), np.int32)
-        table[:len(pages)] = pages
-
-        budget = max(self.cfg.max_prefill_tokens, self.cfg.page_size)
-        if cached:
-            self.counters["prefix_cached_tokens_total"] += cached
-        if m > budget or cached:
-            # chunked prefill: each chunk attends over the paged history
-            # (cached prefix + earlier chunks) — bounds per-step latency
-            # for long prompts (the feature vLLM gives the reference)
-            pos = cached
-            logits = None
-            while pos < n:
-                chunk = req.prompt_tokens[pos: pos + budget]
-                cm = len(chunk)
-                cbucket = self._bucket(cm)
-                ctoks = np.zeros((1, cbucket), np.int32)
-                ctoks[0, :cm] = chunk
-                fn = self._prefill_ctx_fn(cbucket)
-                self.cache, logits = fn(self.params, self.cache,
-                                        jnp.asarray(ctoks),
-                                        jnp.asarray([cm], np.int32),
-                                        jnp.asarray(table[None]),
-                                        jnp.asarray([pos], np.int32))
-                self.counters["prefill_steps_total"] += 1
-                pos += cm
         else:
-            fn = self._prefill_fn(bucket)
-            self.cache, logits = fn(self.params, self.cache,
-                                    jnp.asarray(tokens),
-                                    jnp.asarray([n], np.int32),
-                                    jnp.asarray(table[None]))
-            self.counters["prefill_steps_total"] += 1
-        self.counters["prompt_tokens_total"] += n
-
-        # first sampled token
-        self.sampling = self.sampling.set_slot(
-            free_slot, temperature=req.params.temperature,
-            top_k=req.params.top_k, top_p=req.params.top_p,
-            seed=req.params.seed or self.counters["requests_total"])
-        sub = SamplingState(
-            temperature=self.sampling.temperature[free_slot:free_slot + 1],
-            top_k=self.sampling.top_k[free_slot:free_slot + 1],
-            top_p=self.sampling.top_p[free_slot:free_slot + 1],
-            key=self.sampling.key[free_slot:free_slot + 1])
-        tok, sub = self._sample_one(logits, sub)
-        self.sampling = SamplingState(
-            temperature=self.sampling.temperature,
-            top_k=self.sampling.top_k,
-            top_p=self.sampling.top_p,
-            key=self.sampling.key.at[free_slot].set(sub.key[0]))
-        first = int(tok[0])
+            pages_needed = -(-(n + 1) // self.cfg.page_size)
+            if pages_needed > self.allocator.available:
+                self._requeue_front(req)
+                return False
+            pages = self.allocator.alloc(pages_needed)
 
         slot = self.slots[free_slot]
-        slot.request = req
-        slot.pages = pages
-        slot.position = n
-        slot.remaining = min(req.params.max_tokens,
-                             self.cfg.max_model_len - n)
+        table = np.zeros((self.pages_per_seq,), np.int32)
+        table[:len(pages)] = pages
         self.page_tables[free_slot] = table
-        self.positions[free_slot] = n
-        self.active[free_slot] = True
-        self.last_tokens[free_slot] = first
-
-        req.first_token_time = time.monotonic()
-        self._emit(free_slot, first)
+        slot.request = req
+        slot.pages = list(pages)
+        self._admit_seq += 1
+        slot.seq = self._admit_seq
+        # stage prefill bookkeeping BEFORE anything that can raise, so a
+        # failure path releases exactly the acquired token prefix (shared
+        # refcounts included) via slot.written
+        slot.prefilling = True
+        slot.prefill_pos = cached
+        slot.prefill_tokens = tokens
+        try:
+            self.sampling = self.sampling.set_slot(
+                free_slot, temperature=req.params.temperature,
+                top_k=req.params.top_k, top_p=req.params.top_p,
+                seed=req.params.seed or self.counters["requests_total"])
+            if req.kv_import is not None:
+                self._start_imported(req, free_slot)
+                return True
+            if cached:
+                self.counters["prefix_cached_tokens_total"] += cached
+        except Exception:
+            self._evict_slot(free_slot, commit=False)
+            raise
         return True
 
-    def _admit_imported(self, req: Request, free_slot: int,
-                        pages: list[int]) -> bool:
-        """Decode-role admission: scatter transferred KV pages and start
+    def _start_imported(self, req: Request, free_slot: int):
+        """Decode-role start: scatter transferred KV pages and begin
         decoding at the prompt boundary (no prefill compute)."""
         from kaito_tpu.engine.pd import import_kv
 
         meta, payload, first = req.kv_import
         n = len(req.prompt_tokens)
         n_prompt_pages = -(-n // self.cfg.page_size)
-        self.cache = import_kv(self.cache, pages[:n_prompt_pages], payload, meta)
-        self.counters["prompt_tokens_total"] += n
-
-        table = np.zeros((self.pages_per_seq,), np.int32)
-        table[:len(pages)] = pages
-        self.sampling = self.sampling.set_slot(
-            free_slot, temperature=req.params.temperature,
-            top_k=req.params.top_k, top_p=req.params.top_p,
-            seed=req.params.seed or self.counters["requests_total"])
         slot = self.slots[free_slot]
-        slot.request = req
-        slot.pages = pages
-        slot.position = n
-        slot.remaining = min(req.params.max_tokens,
-                             self.cfg.max_model_len - n)
-        self.page_tables[free_slot] = table
-        self.positions[free_slot] = n
-        self.active[free_slot] = True
-        self.last_tokens[free_slot] = first
-        req.first_token_time = time.monotonic()
-        self._emit(free_slot, first)
+        self.cache = import_kv(self.cache, slot.pages[:n_prompt_pages],
+                               payload, meta)
+        if not req.prompt_counted:
+            self.counters["prompt_tokens_total"] += n
+            req.prompt_counted = True
+        self._begin_decode(free_slot, first, n)
+
+    def _advance_prefills(self) -> bool:
+        """Run ONE bounded prefill chunk for one staged slot
+        (round-robin), completing admission when the prompt is done."""
+        idxs = [i for i, s in enumerate(self.slots)
+                if s.request is not None and s.prefilling]
+        if not idxs:
+            return False
+        i = idxs[self._prefill_rr % len(idxs)]
+        self._prefill_rr += 1
+        slot = self.slots[i]
+        req = slot.request
+        tokens = slot.prefill_tokens
+        n = len(tokens)
+        budget = max(self.cfg.max_prefill_tokens, self.cfg.page_size)
+        pos = slot.prefill_pos
+        chunk = tokens[pos: pos + budget]
+        m = len(chunk)
+        bucket = self._bucket(m)
+        ctoks = np.zeros((1, bucket), np.int32)
+        ctoks[0, :m] = chunk
+        try:
+            if pos == 0 and m == n:
+                fn = self._prefill_fn(bucket)
+                self.cache, logits = fn(self.params, self.cache,
+                                        jnp.asarray(ctoks),
+                                        jnp.asarray([m], np.int32),
+                                        jnp.asarray(self.page_tables[i][None]))
+            else:
+                # chunk attends over the paged history (cached prefix +
+                # earlier chunks) — bounds per-step latency for long
+                # prompts (the feature vLLM gives the reference)
+                fn = self._prefill_ctx_fn(bucket)
+                self.cache, logits = fn(self.params, self.cache,
+                                        jnp.asarray(ctoks),
+                                        jnp.asarray([m], np.int32),
+                                        jnp.asarray(self.page_tables[i][None]),
+                                        jnp.asarray([pos], np.int32))
+        except Exception:
+            logger.exception("prefill failed for %s", req.req_id)
+            self._evict_slot(i, commit=False)
+            self._fail_request(req)
+            self._recover_cache_if_poisoned()
+            return True
+        self.counters["prefill_steps_total"] += 1
+        slot.prefill_pos = pos + m
+        if slot.prefill_pos >= n:
+            if not req.prompt_counted:
+                # resume-after-preempt re-prefills prompt+generated; only
+                # the original prompt counts (once) toward the metric
+                self.counters["prompt_tokens_total"] += len(req.prompt_tokens)
+                req.prompt_counted = True
+            slot.prefilling = False
+            first = self._sample_first(i, logits)
+            self._begin_decode(i, first, n)
         return True
+
+    def _sample_first(self, slot_idx: int, logits) -> int:
+        sub = SamplingState(
+            temperature=self.sampling.temperature[slot_idx:slot_idx + 1],
+            top_k=self.sampling.top_k[slot_idx:slot_idx + 1],
+            top_p=self.sampling.top_p[slot_idx:slot_idx + 1],
+            key=self.sampling.key[slot_idx:slot_idx + 1])
+        tok, sub = self._sample_one(logits, sub)
+        self.sampling = SamplingState(
+            temperature=self.sampling.temperature,
+            top_k=self.sampling.top_k,
+            top_p=self.sampling.top_p,
+            key=self.sampling.key.at[slot_idx].set(sub.key[0]))
+        return int(tok[0])
+
+    def _begin_decode(self, slot_idx: int, first: int, n: int):
+        """Transition a slot to decoding after its prompt KV is in place
+        (prefill completed or KV imported) and emit the first token."""
+        slot = self.slots[slot_idx]
+        req = slot.request
+        slot.prefilling = False
+        slot.position = n
+        slot.remaining = min(req.params.max_tokens - len(req.output_tokens),
+                             self.cfg.max_model_len - n,
+                             self._capacity_tokens - n)
+        self.positions[slot_idx] = n
+        self.active[slot_idx] = True
+        self.last_tokens[slot_idx] = first
+        if req.first_token_time is None:
+            req.first_token_time = time.monotonic()
+        self._emit(slot_idx, first)
+
+    # ------------------------------------------------------------------
+    # Page growth + preemption
+    # ------------------------------------------------------------------
+
+    def _alloc_one_page(self) -> Optional[int]:
+        if self.prefix_cache is not None:
+            got = self.prefix_cache.alloc_raw(1)
+            return got[0] if got else None
+        try:
+            return self.allocator.alloc(1)[0]
+        except MemoryError:
+            return None
+
+    def _preempt_slot(self, victim: int):
+        """Preempt a slot back to the front of the waiting queue; its
+        generated tokens become part of the prompt on resume, so the
+        client stream is seamless."""
+        req = self.slots[victim].request
+        logger.info("preempting %s (slot %d) to reclaim KV pages",
+                    req.req_id, victim)
+        req.preemptions += 1
+        self.counters["preemptions_total"] += 1
+        # evict BEFORE clearing kv_import so imported (foreign) KV pages
+        # release uncommitted — they must never enter the radix tree
+        self._evict_slot(victim, commit=True)
+        req.kv_import = None     # imported KV is consumed; resume recomputes
+        if len(req.resume_tokens()) + 1 > self._capacity_tokens:
+            # the sequence already fills the whole pool: it cannot be
+            # re-admitted (resume needs more pages than exist), and all
+            # its tokens were emitted — finish it at the length cap
+            req.finish_reason = "length"
+            req.finish_time = time.monotonic()
+            req.out.put(None)
+            self.counters["requests_finished_total"] += 1
+            return
+        self._requeue_front(req)
+
+    def _newest_slot(self) -> Optional[int]:
+        candidates = [i for i, s in enumerate(self.slots)
+                      if s.request is not None]
+        if not candidates:
+            return None
+        return max(candidates, key=lambda i: self.slots[i].seq)
+
+    def _ensure_decode_pages(self):
+        """Reserve-on-demand: before a decode step, every active slot
+        must own the page its next KV write lands in; when the pool is
+        dry, the newest-admitted sequence yields (requeue + recompute
+        later) — even if it is the one that needs the page."""
+        ps = self.cfg.page_size
+        for i, slot in enumerate(self.slots):
+            if not self.active[i] or slot.request is None:
+                continue
+            needed = slot.position // ps + 1
+            while len(slot.pages) < needed:
+                page = self._alloc_one_page()
+                if page is not None:
+                    self.page_tables[i, len(slot.pages)] = page
+                    slot.pages.append(page)
+                    continue
+                victim = self._newest_slot()
+                if victim is None or victim == i:
+                    # this slot is itself the newest (or the only one):
+                    # it yields its pages and waits for the pool
+                    self._preempt_slot(i)
+                    break
+                self._preempt_slot(victim)
 
     def _decode_once(self):
         cache, sampling, next_tokens = self._decode_fn(
@@ -755,8 +911,5 @@ class InferenceEngine:
                     prompt_tokens=list(req.prompt_tokens),
                     first_token=req.output_tokens[0]))
             req.out.put(None)
-            self._release_pages(req, slot.pages)
-            slot.request = None
-            slot.pages = []
-            self.active[slot_idx] = False
+            self._evict_slot(slot_idx, commit=True)
             self.counters["requests_finished_total"] += 1

@@ -64,6 +64,9 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.kprefix_release.argtypes = [
         c.c_void_p, c.POINTER(c.c_int32), c.c_int32,
         c.POINTER(c.c_int32), c.c_int32]
+    lib.kprefix_alloc_raw.restype = c.c_int32
+    lib.kprefix_alloc_raw.argtypes = [
+        c.c_void_p, c.c_int32, c.POINTER(c.c_int32)]
     lib.kprefix_release_uncommitted.argtypes = [
         c.c_void_p, c.POINTER(c.c_int32), c.c_int32,
         c.POINTER(c.c_int32), c.c_int32]
@@ -124,6 +127,15 @@ class NativePrefixCache:
         pg = np.asarray(pages, np.int32)
         self._lib.kprefix_release(self._h, _i32ptr(toks), len(toks),
                                   _i32ptr(pg), len(pg))
+
+    def alloc_raw(self, n: int) -> Optional[list[int]]:
+        """Plain page allocation for on-demand sequence growth; the pages
+        return through release()/release_uncommitted() with the rest."""
+        out = np.zeros(max(n, 1), np.int32)
+        got = self._lib.kprefix_alloc_raw(self._h, n, _i32ptr(out))
+        if got < 0:
+            return None
+        return list(out[:got])
 
     def release_uncommitted(self, tokens: list[int], pages: list[int]) -> None:
         """Return shared refs and free exclusive pages WITHOUT committing

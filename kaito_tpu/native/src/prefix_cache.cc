@@ -198,6 +198,28 @@ void kprefix_release(void* handle, const int32_t* tokens, int32_t n_tokens,
   }
 }
 
+// Raw page allocation (no radix-tree interaction): used by the engine
+// for reserve-on-demand growth of a running sequence's page list.  The
+// pages are later returned through kprefix_release(_uncommitted) along
+// with the sequence's acquire()d pages.  Returns n on success (ids in
+// out_pages), -1 when the pool (after eviction) cannot supply n pages.
+int32_t kprefix_alloc_raw(void* handle, int32_t n, int32_t* out_pages) {
+  auto* c = static_cast<PrefixCache*>(handle);
+  std::lock_guard<std::mutex> lock(c->mu);
+  std::vector<int32_t> taken;
+  taken.reserve(n);
+  for (int32_t i = 0; i < n; i++) {
+    int32_t p = c->take_page();
+    if (p < 0) {
+      for (int32_t q : taken) c->free_pages.push_back(q);
+      return -1;
+    }
+    taken.push_back(p);
+  }
+  std::memcpy(out_pages, taken.data(), taken.size() * sizeof(int32_t));
+  return n;
+}
+
 // Release WITHOUT committing: return shared refs (the contiguous prefix
 // of pages that matched committed nodes at acquire time) and free the
 // rest, entering nothing new into the tree.  Used for failure paths
