@@ -28,6 +28,8 @@ class EngineConfig:
     kv_dtype: str = "bfloat16"
     seed: int = 0
     tensor_parallel: int = 1             # TP degree (mesh "tensor" axis)
+    pipeline_parallel: int = 1           # PP stages (mesh "pipeline" axis)
+    pp_microbatches: int = 4             # decode microbatches through the ring
     data_parallel: int = 1               # engine replica groups
     use_pallas: Optional[bool] = None    # None = auto (TPU yes, CPU no)
     # serving-side knobs carried over from the reference wrapper surface

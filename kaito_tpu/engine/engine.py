@@ -160,6 +160,15 @@ class InferenceEngine:
             self.model.moe_impl = "ragged"  # grouped-matmul serving path
         self.tokenizer = load_tokenizer(self.md.hf_id, arch.vocab_size)
         self.mesh = mesh if mesh is not None else self._build_mesh()
+        self.pp_exec = None
+        if cfg.pipeline_parallel > 1:
+            if cfg.tensor_parallel > 1:
+                raise ValueError("pipeline_parallel composes with "
+                                 "tensor_parallel in a later round")
+            if cfg.pd_enabled:
+                raise ValueError("P/D disaggregation is not supported with "
+                                 "pipeline-parallel serving")
+            self.pp_exec = self._build_pp_executor()
 
         if not cfg.max_model_len:
             cfg.max_model_len = min(self.md.max_model_len, 8192)
@@ -170,12 +179,8 @@ class InferenceEngine:
             | {cfg.max_model_len}))
         num_pages = cfg.max_pages or self._derive_max_pages()
         num_pages = max(num_pages, cfg.max_num_seqs * self.pages_per_seq // 4 + 2)
-        self.cache = create_kv_cache(arch, num_pages, cfg.page_size,
-                                     jnp.dtype(cfg.kv_dtype))
-        if self.mesh is not None:
-            sh = self._cache_sharding()
-            self.cache = KVCache(k=jax.device_put(self.cache.k, sh),
-                                 v=jax.device_put(self.cache.v, sh))
+        self._num_pages = num_pages
+        self.cache = self._fresh_cache()
         logger.info("KV cache: %d pages x %d tokens (%.2f GiB)",
                     num_pages, cfg.page_size,
                     2 * self.cache.k.nbytes / 2**30)
@@ -186,6 +191,8 @@ class InferenceEngine:
 
             self.params = apply_adapters_to_params(self.model, self.params,
                                                    cfg.adapters_dir)
+        if self.pp_exec is not None:
+            self.params = self.pp_exec.stage_params(self.params)
         self.prefix_cache = None
         if cfg.enable_prefix_caching and not self.model.is_mla \
                 and self.mesh is None:
@@ -258,6 +265,44 @@ class InferenceEngine:
             raise ValueError(f"tensor_parallel={tp} but only "
                              f"{len(devices)} devices visible")
         return build_mesh(make_mesh_spec(tensor=tp), devices[:tp])
+
+    def _build_pp_executor(self):
+        """Stage-sharded serving executor over the planner's pipeline
+        axis (tier 3; reference interface.go:519-530)."""
+        from jax.sharding import Mesh
+
+        from kaito_tpu.parallel.pp_serve import PipelineServeExecutor
+
+        pp = self.cfg.pipeline_parallel
+        devices = jax.devices()
+        if len(devices) < pp:
+            raise ValueError(f"pipeline_parallel={pp} but only "
+                             f"{len(devices)} devices visible")
+        mesh = Mesh(np.array(devices[:pp]), ("pipeline",))
+        if self.cfg.pp_microbatches < 1:
+            raise ValueError(f"pp_microbatches must be >= 1, got "
+                             f"{self.cfg.pp_microbatches}")
+        M = min(self.cfg.pp_microbatches, self.cfg.max_num_seqs)
+        while self.cfg.max_num_seqs % M:
+            M -= 1
+        if M != self.cfg.pp_microbatches:
+            logger.info("pp_microbatches adjusted %d -> %d to divide "
+                        "max_num_seqs=%d (pipeline overlap is M/(M+S-1))",
+                        self.cfg.pp_microbatches, M, self.cfg.max_num_seqs)
+        return PipelineServeExecutor(self.model, mesh, num_microbatches=M)
+
+    def _fresh_cache(self) -> KVCache:
+        """Zeroed page pool, laid out for the active parallelism mode."""
+        cache = create_kv_cache(self.md.arch, self._num_pages,
+                                self.cfg.page_size,
+                                jnp.dtype(self.cfg.kv_dtype))
+        if self.pp_exec is not None:
+            return self.pp_exec.stage_cache(cache)
+        if self.mesh is not None:
+            sh = self._cache_sharding()
+            return KVCache(k=jax.device_put(cache.k, sh),
+                           v=jax.device_put(cache.v, sh))
+        return cache
 
     def _param_shardings(self):
         from jax.sharding import NamedSharding
@@ -333,11 +378,17 @@ class InferenceEngine:
 
     def _build_decode_fn(self):
         model = self.model
+        pp_decode = (self.pp_exec.build_decode_fn()
+                     if self.pp_exec is not None else None)
 
         @partial(jax.jit, donate_argnums=(1, 2))
         def decode_step(params, cache, sampling, tokens, positions, page_tables, active):
-            cache, logits = model.decode(params, cache, tokens, positions,
-                                         page_tables, active)
+            if pp_decode is not None:
+                cache, logits = pp_decode(params, cache, tokens, positions,
+                                          page_tables, active)
+            else:
+                cache, logits = model.decode(params, cache, tokens, positions,
+                                             page_tables, active)
             next_tokens, sampling = sample(logits, sampling)
             return cache, sampling, next_tokens
 
@@ -347,9 +398,14 @@ class InferenceEngine:
         fn = self._prefill_fns.get(bucket)
         if fn is None:
             model = self.model
+            pp_prefill = (self.pp_exec.build_prefill_fn(with_context=False)
+                          if self.pp_exec is not None else None)
 
             @partial(jax.jit, donate_argnums=(1,))
             def prefill_step(params, cache, tokens, true_lens, page_tables):
+                if pp_prefill is not None:
+                    return pp_prefill(params, cache, tokens, true_lens,
+                                      page_tables)
                 cache, logits, _ = model.prefill(params, cache, tokens,
                                                  true_lens, page_tables)
                 return cache, logits
@@ -363,10 +419,15 @@ class InferenceEngine:
         fn = self._prefill_fns.get(key)
         if fn is None:
             model = self.model
+            pp_prefill = (self.pp_exec.build_prefill_fn(with_context=True)
+                          if self.pp_exec is not None else None)
 
             @partial(jax.jit, donate_argnums=(1,))
             def prefill_ctx(params, cache, tokens, true_lens, page_tables,
                             start_pos):
+                if pp_prefill is not None:
+                    return pp_prefill(params, cache, tokens, true_lens,
+                                      page_tables, start_pos)
                 cache, logits, _ = model.prefill(params, cache, tokens,
                                                  true_lens, page_tables,
                                                  start_pos=start_pos)
@@ -554,22 +615,15 @@ class InferenceEngine:
             # device contents are gone: nothing in flight may survive and
             # the prefix tree must not advertise zeroed pages
             self._fail_active_slots()
-            num_pages = self.allocator.num_pages
             if self.prefix_cache is not None:
                 from kaito_tpu.native import NativePrefixCache
 
-                self.prefix_cache = NativePrefixCache(num_pages,
+                self.prefix_cache = NativePrefixCache(self._num_pages,
                                                       self.cfg.page_size)
                 self.allocator = self.prefix_cache
             else:
-                self.allocator = PageAllocator(num_pages)
-            self.cache = create_kv_cache(
-                self.md.arch, num_pages, self.cfg.page_size,
-                jnp.dtype(self.cfg.kv_dtype))
-            if self.mesh is not None:
-                sh = self._cache_sharding()
-                self.cache = KVCache(k=jax.device_put(self.cache.k, sh),
-                                     v=jax.device_put(self.cache.v, sh))
+                self.allocator = PageAllocator(self._num_pages)
+            self.cache = self._fresh_cache()
 
     def step(self) -> bool:
         """One scheduler iteration. Returns False when idle.

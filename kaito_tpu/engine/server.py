@@ -371,6 +371,8 @@ def load_config_file(cfg: EngineConfig, path: str) -> EngineConfig:
         "served_model_name": "served_model_name",
         "tensor-parallel-size": "tensor_parallel",
         "tensor_parallel_size": "tensor_parallel",
+        "pipeline-parallel-size": "pipeline_parallel",
+        "pipeline_parallel_size": "pipeline_parallel",
         "data-parallel-size": "data_parallel",
         "data_parallel_size": "data_parallel",
         "page-size": "page_size", "page_size": "page_size",
@@ -392,6 +394,8 @@ def main(argv=None):
     ap.add_argument("--max-num-seqs", type=int, default=8)
     ap.add_argument("--tensor-parallel-size", type=int,
                     default=int(os.environ.get("KAITO_TENSOR_PARALLEL", "1")))
+    ap.add_argument("--pipeline-parallel-size", type=int,
+                    default=int(os.environ.get("KAITO_PIPELINE_PARALLEL", "1")))
     ap.add_argument("--served-model-name", default="")
     ap.add_argument("--dtype", default="")
     ap.add_argument("--kaito-config-file", default="")
@@ -413,6 +417,7 @@ def main(argv=None):
         model=args.model, port=args.port, max_model_len=args.max_model_len,
         max_num_seqs=args.max_num_seqs, served_model_name=args.served_model_name,
         tensor_parallel=args.tensor_parallel_size,
+        pipeline_parallel=args.pipeline_parallel_size,
         dtype=args.dtype or ("bfloat16" if on_tpu else "float32"),
         kv_dtype=args.dtype or ("bfloat16" if on_tpu else "float32"),
         adapters_dir=args.kaito_adapters_dir,

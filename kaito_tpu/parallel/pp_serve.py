@@ -1,0 +1,259 @@
+"""Pipeline-parallel serving executor (shard_map + ppermute).
+
+The serving side of the planner's tier 3 (``pipeline`` mesh axis) — the
+TPU-native counterpart of the reference's multi-node vLLM serving
+(``--pipeline-parallel-size`` + Ray executor,
+/root/reference/pkg/model/interface.go:519-560).  Where the reference
+splits layers across node boundaries and lets Ray drive per-stage
+processes, here the model's scanned layer stack reshapes to
+``[S, L/S, ...]`` and shards over the pipeline axis of one jitted SPMD
+program; the paged KV cache shards the same way, so every stage owns
+the KV pages for its own layers and no KV ever crosses a stage
+boundary — only the [mb, 1, E] activations move, via ``ppermute``.
+
+Decode runs the GPipe schedule: the decode batch splits into M
+microbatches that stream through the stage ring in M + S - 1 ticks, so
+at steady state every stage computes a different microbatch.  Prefill
+flows one request through the ring (a single-request prefill is
+inherently sequential; stages overlap across *ticks* instead).
+
+Scope (v1): dense single-group models (no MoE/MLA), global attention
+(no sliding-window scan flags), pipeline-only mesh — the tensor axis
+composes inside stages in a later round.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from kaito_tpu.engine.kv_cache import KVCache
+from kaito_tpu.engine.model import TransformerLM
+from kaito_tpu.parallel.pipeline import split_stage_params
+
+
+class PipelineServeExecutor:
+    """Builds stage-sharded decode/prefill step functions for the engine."""
+
+    def __init__(self, model: TransformerLM, mesh: Mesh,
+                 num_microbatches: int = 4, axis: str = "pipeline"):
+        if model.is_mla or model.arch.num_experts > 0:
+            raise ValueError("pipeline-parallel serving v1 covers dense "
+                             "models only (no MoE/MLA)")
+        if model.arch.sliding_window:
+            raise ValueError("pipeline-parallel serving v1 does not cover "
+                             "sliding-window attention")
+        self.model = model
+        self.mesh = mesh
+        self.axis = axis
+        self.num_stages = mesh.shape[axis]
+        (self.group,) = model.groups
+        if model.arch.num_layers % self.num_stages:
+            raise ValueError(f"{model.arch.num_layers} layers do not split "
+                             f"into {self.num_stages} stages")
+        self.num_microbatches = num_microbatches
+
+    # ------------------------------------------------------------------
+    # Layout
+    # ------------------------------------------------------------------
+
+    def _param_specs(self, staged_params: dict) -> dict:
+        gname = self.group.name
+        return {
+            k: (jax.tree.map(lambda _: P(self.axis), v)
+                if k == gname else jax.tree.map(lambda _: P(), v))
+            for k, v in staged_params.items()
+        }
+
+    def stage_params(self, params: dict) -> dict:
+        """[L, ...] layer stacks -> [S, L/S, ...] sharded over the
+        pipeline axis; top-level params replicate."""
+        staged = split_stage_params(self.model, params, self.num_stages)
+        specs = self._param_specs(staged)
+        shardings = jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s), specs,
+            is_leaf=lambda x: isinstance(x, P))
+        return jax.device_put(staged, shardings)
+
+    def stage_cache(self, cache: KVCache) -> KVCache:
+        """[L, pages, H, ps, D] -> [S, L/S, pages, H, ps, D] sharded over
+        the pipeline axis (each stage owns its layers' KV)."""
+        S = self.num_stages
+        sh = NamedSharding(self.mesh, P(self.axis))
+
+        def split(a):
+            return jax.device_put(
+                a.reshape((S, a.shape[0] // S) + a.shape[1:]), sh)
+
+        return KVCache(k=split(cache.k), v=split(cache.v))
+
+    def _local_view(self, params: dict, ck, cv):
+        """Inside shard_map: strip the stage dim from this stage's shard."""
+        gname = self.group.name
+        stack = jax.tree.map(lambda v: v[0], params[gname])
+        local_params = {**params, gname: stack}
+        return local_params, ck[0], cv[0]
+
+    # ------------------------------------------------------------------
+    # Decode (GPipe microbatching)
+    # ------------------------------------------------------------------
+
+    def build_decode_fn(self):
+        model, axis = self.model, self.axis
+        S, M = self.num_stages, self.num_microbatches
+        E = model.arch.hidden_size
+        V = model.arch.vocab_size
+        fwd = [(i, (i + 1) % S) for i in range(S)]
+
+        def local_decode(params, ck, cv, tokens, positions, page_tables,
+                         active):
+            p = jax.lax.axis_index(axis)
+            local_params, ck_l, cv_l = self._local_view(params, ck, cv)
+            B = tokens.shape[0]
+            mb = B // M
+            pos = positions.reshape(M, mb)
+            pts = page_tables.reshape(M, mb, -1)
+            act = active.reshape(M, mb)
+            # embed once per microbatch (only stage 0 consumes it; the
+            # gather is cheap enough to not gate on p == 0)
+            x0_all = model._embed(local_params,
+                                  tokens.reshape(M, mb)[:, :, None])
+
+            def tick(carry, t):
+                recv, ck_l, cv_l, acc = carry
+                i_rel = t - p
+                valid = (i_rel >= 0) & (i_rel < M)
+                i = jnp.clip(i_rel, 0, M - 1)
+                x_in = jnp.where(p == 0, x0_all[i], recv)
+                cache_l = KVCache(k=ck_l, v=cv_l)
+                # invalid (warm-up/drain) ticks mask active so their
+                # garbage KV lands on the null page
+                x_out, cache_l = model._run_layers(
+                    local_params, cache_l, x_in, "decode",
+                    positions=pos[i][:, None], page_tables=pts[i],
+                    lengths=pos[i] + 1, true_lens=None,
+                    active=act[i] & valid)
+                ck_l, cv_l = cache_l.k, cache_l.v
+                # final-norm + vocab projection only on the last stage's
+                # valid ticks — everywhere else the accumulator stays 0
+                use = valid & (p == S - 1)
+                lg = jax.lax.cond(
+                    use,
+                    lambda x: model._logits(
+                        local_params,
+                        model._norm(x, local_params, "final_norm")[:, 0]
+                    ).astype(jnp.float32),
+                    lambda x: jnp.zeros((mb, V), jnp.float32),
+                    x_out)
+                acc = acc.at[i].set(jnp.where(use, lg, acc[i]))
+                sent = jax.lax.ppermute(x_out, axis, fwd)
+                return (sent, ck_l, cv_l, acc), None
+
+            recv0 = jnp.zeros((mb, 1, E), model.dtype)
+            acc0 = jnp.zeros((M, mb, V), jnp.float32)
+            (_, ck_l, cv_l, acc), _ = jax.lax.scan(
+                tick, (recv0, ck_l, cv_l, acc0), jnp.arange(S + M - 1))
+            # only the last stage wrote logits; psum replicates them
+            logits = jax.lax.psum(acc, axis)
+            return ck_l[None], cv_l[None], logits.reshape(B, V)
+
+        ax = self.axis
+        sharded = None
+
+        def decode(params, cache, tokens, positions, page_tables, active):
+            nonlocal sharded
+            if sharded is None:
+                specs = self._param_specs(params)
+                sharded = jax.shard_map(
+                    local_decode, mesh=self.mesh,
+                    in_specs=(specs, P(ax), P(ax), P(), P(), P(), P()),
+                    out_specs=(P(ax), P(ax), P()),
+                    check_vma=False)
+            k, v, logits = sharded(params, cache.k, cache.v, tokens,
+                                   positions, page_tables, active)
+            return KVCache(k=k, v=v), logits
+
+        return decode
+
+    # ------------------------------------------------------------------
+    # Prefill (one request through the ring)
+    # ------------------------------------------------------------------
+
+    def build_prefill_fn(self, with_context: bool):
+        model, axis = self.model, self.axis
+        S = self.num_stages
+        E = model.arch.hidden_size
+        V = model.arch.vocab_size
+        fwd = [(i, (i + 1) % S) for i in range(S)]
+
+        def local_prefill(params, ck, cv, tokens, true_lens, page_tables,
+                          start_pos):
+            p = jax.lax.axis_index(axis)
+            local_params, ck_l, cv_l = self._local_view(params, ck, cv)
+            B, T = tokens.shape
+            rel = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+            positions = rel + start_pos[:, None] if with_context else rel
+            x0 = model._embed(local_params, tokens)
+
+            def tick(carry, t):
+                recv, ck_l, cv_l, acc = carry
+                valid = t == p               # stage p's turn at tick p
+                x_in = jnp.where(p == 0, x0, recv)
+                # inactive ticks zero true_lens: garbage KV -> null page
+                tl = jnp.where(valid, true_lens, 0)
+                cache_l = KVCache(k=ck_l, v=cv_l)
+                x_out, cache_l = model._run_layers(
+                    local_params, cache_l, x_in, "prefill",
+                    positions=positions, page_tables=page_tables,
+                    lengths=tl, true_lens=tl, active=None,
+                    start_pos=start_pos if with_context else None)
+                ck_l, cv_l = cache_l.k, cache_l.v
+                use = valid & (p == S - 1)
+
+                def final(x):
+                    h = model._norm(x, local_params, "final_norm")
+                    last = jnp.take_along_axis(
+                        h, jnp.maximum(true_lens - 1, 0)[:, None, None]
+                        .astype(jnp.int32), axis=1)[:, 0]
+                    return model._logits(local_params,
+                                         last).astype(jnp.float32)
+
+                lg = jax.lax.cond(
+                    use, final, lambda x: jnp.zeros((B, V), jnp.float32),
+                    x_out)
+                acc = jnp.where(use, lg, acc)
+                sent = jax.lax.ppermute(x_out, axis, fwd)
+                return (sent, ck_l, cv_l, acc), None
+
+            recv0 = jnp.zeros((B, T, E), model.dtype)
+            acc0 = jnp.zeros((B, V), jnp.float32)
+            (_, ck_l, cv_l, acc), _ = jax.lax.scan(
+                tick, (recv0, ck_l, cv_l, acc0), jnp.arange(S))
+            logits = jax.lax.psum(acc, axis)
+            return ck_l[None], cv_l[None], logits
+
+        ax = self.axis
+        sharded = None
+
+        def prefill(params, cache, tokens, true_lens, page_tables,
+                    start_pos=None):
+            nonlocal sharded
+            if sharded is None:
+                specs = self._param_specs(params)
+                sharded = jax.shard_map(
+                    local_prefill, mesh=self.mesh,
+                    in_specs=(specs, P(ax), P(ax), P(), P(), P(), P()),
+                    out_specs=(P(ax), P(ax), P()),
+                    check_vma=False)
+            if start_pos is None:
+                start_pos = jnp.zeros((tokens.shape[0],), jnp.int32)
+            k, v, logits = sharded(params, cache.k, cache.v, tokens,
+                                   true_lens, page_tables, start_pos)
+            return KVCache(k=k, v=v), logits
+
+        return prefill
