@@ -455,9 +455,8 @@ class InferenceEngine:
     def num_running(self) -> int:
         return int(self.active.sum())
 
-    def submit(self, prompt_tokens: list[int], params: SamplingParams,
-               req_id: Optional[str] = None,
-               export_kv: bool = False) -> Request:
+    def _validate_submit(self, prompt_tokens: list[int],
+                         params: SamplingParams) -> None:
         if len(prompt_tokens) >= self.cfg.max_model_len:
             raise ValueError(
                 f"prompt length {len(prompt_tokens)} exceeds max_model_len "
@@ -468,6 +467,11 @@ class InferenceEngine:
                 f"capacity {self._capacity_tokens} tokens")
         if params.max_tokens < 1:
             raise ValueError(f"max_tokens must be >= 1, got {params.max_tokens}")
+
+    def submit(self, prompt_tokens: list[int], params: SamplingParams,
+               req_id: Optional[str] = None,
+               export_kv: bool = False) -> Request:
+        self._validate_submit(prompt_tokens, params)
         req = Request(req_id or f"req-{self.counters['requests_total']}",
                       list(prompt_tokens), params, export_kv=export_kv)
         with self._lock:
@@ -500,6 +504,13 @@ class InferenceEngine:
             self.waiting.append(req)
         self._wake.set()
         return req
+
+    def abort(self, req: Request) -> None:
+        """Request cancellation; the scheduler retires the slot at its
+        next touch.  (MultiHostEngine overrides: aborts must reach every
+        process via the step broadcast before the scheduler acts.)"""
+        req.aborted = True
+        self._wake.set()
 
     def generate(self, prompt: str, params: Optional[SamplingParams] = None) -> str:
         """Blocking single-request helper (tests, benchmark probe)."""

@@ -16,6 +16,7 @@ axes map onto the planner's mesh via kaito_tpu.parallel.sharding.
 from __future__ import annotations
 
 import math
+import zlib
 from dataclasses import dataclass
 from functools import cached_property, partial
 from typing import Optional
@@ -30,6 +31,15 @@ from kaito_tpu.models.metadata import AttentionKind, ModelArch
 
 VOCAB_ALIGN = 128
 _BIG_WINDOW = 1 << 30
+
+
+def _name_salt(name: str) -> int:
+    """Stable per-parameter PRNG salt.  Python's hash() is salted per
+    process, which made synthetic weights differ across processes — a
+    correctness hazard for multi-host lockstep serving (each process
+    traces its own init program) and a source of cross-run test flakes
+    (per-process weight draws occasionally produce argmax near-ties)."""
+    return zlib.crc32(name.encode()) & 0x7FFFFFFF
 
 
 @dataclass(frozen=True)
@@ -183,7 +193,7 @@ class TransformerLM:
                 params[spec_key] = jnp.zeros(shape, self.dtype) if "bias" in spec_key or self.arch.norm_offset else jnp.ones(shape, self.dtype)
             else:
                 params[spec_key] = 0.02 * jax.random.normal(
-                    jax.random.fold_in(keys[0], hash(spec_key) % 2**31), shape, self.dtype)
+                    jax.random.fold_in(keys[0], _name_salt(spec_key)), shape, self.dtype)
         for gi, g in enumerate(self.groups):
             layer: dict = {}
             for name, (shape, _) in self._layer_specs(g.moe).items():
@@ -196,7 +206,7 @@ class TransformerLM:
                     fan_in = shape[-2] if len(shape) >= 2 else shape[-1]
                     std = 1.0 / math.sqrt(fan_in)
                     init = std * jax.random.normal(
-                        jax.random.fold_in(keys[1 + gi], hash(name) % 2**31), full, self.dtype)
+                        jax.random.fold_in(keys[1 + gi], _name_salt(name)), full, self.dtype)
                 layer[name] = init
             params[g.name] = layer
         return params

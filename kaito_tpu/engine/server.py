@@ -291,7 +291,7 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                     cut = min(sent_text.find(s) for s in stop_strs
                               if s in sent_text)
                     delta = sent_text[:cut][len(sent_text) - len(delta):]
-                    req.aborted = True
+                    st.engine.abort(req)
                     stopped = True
                 if delta:
                     chunk = dict(base)
@@ -412,6 +412,13 @@ def main(argv=None):
 
     import jax
 
+    # multi-host rendezvous BEFORE any backend use: pod 0 is the JAX
+    # coordinator (the role Ray's head node plays for the reference,
+    # interface.go:534-560); single-process runs are a no-op
+    from kaito_tpu.parallel.mesh import initialize_distributed
+
+    initialize_distributed()
+
     on_tpu = jax.devices()[0].platform not in ("cpu",)
     cfg = EngineConfig(
         model=args.model, port=args.port, max_model_len=args.max_model_len,
@@ -431,8 +438,20 @@ def main(argv=None):
         cfg = load_config_file(cfg, args.kaito_config_file)
 
     logging.basicConfig(level=logging.INFO)
-    engine = InferenceEngine(cfg)
-    engine.start()
+    if jax.process_count() > 1:
+        # leader-only HTTP; workers follow the step broadcast headless
+        from kaito_tpu.engine.multihost import MultiHostEngine
+
+        engine = MultiHostEngine(cfg)
+        if not engine.is_leader:
+            logger.info("worker process %d: joining lockstep loop",
+                        jax.process_index())
+            engine.run_worker()
+            return
+        engine.start()
+    else:
+        engine = InferenceEngine(cfg)
+        engine.start()
     server = make_server(engine, cfg, host=args.host)
     logger.info("serving %s on %s:%d", cfg.model, args.host, cfg.port)
     try:

@@ -83,8 +83,12 @@ def initialize_distributed(
     if process_id is None:
         process_id = int(os.environ.get("TPU_WORKER_ID", "0"))
     if coordinator_address is None:
-        host = os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",")[0]
-        coordinator_address = f"{host}:8476"
+        # manifests inject KAITO_COORDINATOR (pod-0 headless DNS); fall
+        # back to hostname-derived for bare GKE TPU slices
+        coordinator_address = os.environ.get("KAITO_COORDINATOR", "")
+        if not coordinator_address:
+            host = os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",")[0]
+            coordinator_address = f"{host}:8476"
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
