@@ -39,6 +39,7 @@ class EngineConfig:
     weights_dir: str = ""                # safetensors checkpoint dir ("" = synthetic)
     disable_rate_limit: bool = False
     enable_prefix_caching: bool = True   # native radix-tree prefix reuse
+    host_kv_offload_bytes: int = 0       # host-RAM KV spill tier (0 = off)
     pd_enabled: bool = False             # P/D side-channel routes (MRI roles)
     pd_source_allowlist: str = ""        # comma URL prefixes for KV pulls
     max_queue_len: int = 256

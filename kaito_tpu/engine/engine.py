@@ -209,6 +209,18 @@ class InferenceEngine:
         # a single sequence can never outgrow the whole pool (generation
         # is length-capped so the preempt-self path always terminates)
         self._capacity_tokens = (num_pages - 1) * cfg.page_size
+        self.host_kv = None
+        if cfg.host_kv_offload_bytes > 0:
+            if self.mesh is not None or self.pp_exec is not None:
+                logger.warning(
+                    "host KV offload is single-chip only in this round; "
+                    "TP/PP engines fall back to preempt-recompute")
+            else:
+                from kaito_tpu.engine.host_offload import HostKVPool
+
+                self.host_kv = HostKVPool(cfg.host_kv_offload_bytes)
+                logger.info("host KV offload tier: %.2f GiB",
+                            cfg.host_kv_offload_bytes / 2**30)
         S = cfg.max_num_seqs
         self.slots = [_Slot() for _ in range(S)]
         self.page_tables = np.zeros((S, self.pages_per_seq), np.int32)
@@ -237,6 +249,8 @@ class InferenceEngine:
             "decode_steps_total": 0,
             "prefix_cached_tokens_total": 0,
             "preemptions_total": 0,
+            "host_kv_spilled_pages_total": 0,
+            "host_kv_restored_pages_total": 0,
         }
 
         self._decode_fn = self._build_decode_fn()
@@ -594,6 +608,8 @@ class InferenceEngine:
     def _fail_request(self, req: Request):
         req.finish_reason = "error"
         req.finish_time = time.monotonic()
+        if self.host_kv is not None:
+            self.host_kv.discard(req.req_id)
         req.out.put(None)
 
     def _fail_active_slots(self):
@@ -674,6 +690,8 @@ class InferenceEngine:
             if req is None:
                 return admitted
             if req.aborted:
+                if self.host_kv is not None:
+                    self.host_kv.discard(req.req_id)
                 req.out.put(None)
                 admitted = True
                 continue
@@ -698,6 +716,8 @@ class InferenceEngine:
         tokens = req.resume_tokens()
         n = len(tokens)
         cached = 0
+        has_spill = (self.host_kv is not None and req.kv_import is None
+                     and self.host_kv.has(req.req_id))
         # leave one page of headroom per decoding slot so admissions
         # don't trigger immediate grow-preempt churn
         headroom = sum(1 for i, s in enumerate(self.slots)
@@ -706,10 +726,13 @@ class InferenceEngine:
             self._requeue_front(req)
             return False
         if self.prefix_cache is not None:
-            # PD imports carry foreign KV bytes: acquire EXCLUSIVE pages
-            # (empty-token acquire shares nothing) so a transfer can
-            # neither overwrite shared pages nor commit into the tree
-            acquire_tokens = [] if req.kv_import is not None else tokens
+            # PD imports carry foreign KV bytes, and spilled sequences
+            # scatter host pages over their slots: both acquire
+            # EXCLUSIVE pages (empty-token acquire shares nothing) so
+            # they can neither overwrite shared pages nor inherit a
+            # cached prefix they would immediately clobber
+            acquire_tokens = [] if (req.kv_import is not None or has_spill) \
+                else tokens
             res = self.prefix_cache.acquire(acquire_tokens, n + 1)
             if res is None:
                 self._requeue_front(req)
@@ -747,6 +770,8 @@ class InferenceEngine:
             if req.kv_import is not None:
                 self._start_imported(req, free_slot)
                 return True
+            if has_spill and self._try_restore(req, free_slot):
+                return True       # resumed from host pages, no prefill
             if cached:
                 self.counters["prefix_cached_tokens_total"] += cached
         except Exception:
@@ -873,17 +898,24 @@ class InferenceEngine:
     def _preempt_slot(self, victim: int):
         """Preempt a slot back to the front of the waiting queue; its
         generated tokens become part of the prompt on resume, so the
-        client stream is seamless."""
+        client stream is seamless.  With the host offload tier, the
+        victim's written KV spills to host RAM first, so resume is a
+        page restore instead of a full recompute."""
         req = self.slots[victim].request
         logger.info("preempting %s (slot %d) to reclaim KV pages",
                     req.req_id, victim)
+        will_requeue = len(req.resume_tokens()) + 1 <= self._capacity_tokens
+        if will_requeue:
+            # spill only sequences that will actually resume — a
+            # length-capped sequence would leak a maximal host entry
+            self._spill_slot(victim)
         req.preemptions += 1
         self.counters["preemptions_total"] += 1
         # evict BEFORE clearing kv_import so imported (foreign) KV pages
         # release uncommitted — they must never enter the radix tree
         self._evict_slot(victim, commit=True)
         req.kv_import = None     # imported KV is consumed; resume recomputes
-        if len(req.resume_tokens()) + 1 > self._capacity_tokens:
+        if not will_requeue:
             # the sequence already fills the whole pool: it cannot be
             # re-admitted (resume needs more pages than exist), and all
             # its tokens were emitted — finish it at the length cap
@@ -893,6 +925,72 @@ class InferenceEngine:
             self.counters["requests_finished_total"] += 1
             return
         self._requeue_front(req)
+
+    def _spill_slot(self, slot_idx: int) -> None:
+        """Copy a decoding slot's written KV pages into the host pool
+        (async D2H) ahead of eviction; no-op when the tier is off or the
+        slot holds imported/partial state."""
+        slot = self.slots[slot_idx]
+        req = slot.request
+        if self.host_kv is None or req.kv_import is not None \
+                or slot.prefilling:
+            return
+        written = slot.position
+        n_pages = -(-written // self.cfg.page_size)
+        if n_pages < 1:
+            return
+        from kaito_tpu.engine.host_offload import gather_pages
+
+        # pad the id list to a power of two so gather/scatter compile
+        # O(log pages_per_seq) programs, not one per page count; pad
+        # slots gather/scatter the null page (garbage by design)
+        bucket = 1 << (n_pages - 1).bit_length()
+        ids = np.zeros((bucket,), np.int32)
+        ids[:n_pages] = slot.pages[:n_pages]
+        k_pages, v_pages = gather_pages(self.cache.k, self.cache.v,
+                                        jnp.asarray(ids))
+        if self.host_kv.put(req.req_id, k_pages, v_pages, written):
+            self.counters["host_kv_spilled_pages_total"] += n_pages
+        # else: entry can never fit; resume recomputes
+
+    def _try_restore(self, req: Request, free_slot: int) -> bool:
+        """Resume a spilled sequence by scattering its host pages back
+        into the slot's freshly acquired pages (no prefill compute)."""
+        entry = self.host_kv.pop(req.req_id) if self.host_kv else None
+        if entry is None:
+            return False
+        slot = self.slots[free_slot]
+        n_pages = -(-entry.written // self.cfg.page_size)
+        if len(slot.pages) < n_pages \
+                or entry.written != len(req.resume_tokens()) - 1:
+            return False    # stale entry: fall back to recompute
+        from kaito_tpu.engine.host_offload import scatter_pages
+
+        # mirror the spill's power-of-two padding; pad slots target the
+        # null page, whose content is garbage by design
+        bucket = entry.k.shape[1]
+        ids = np.zeros((bucket,), np.int32)
+        ids[:n_pages] = slot.pages[:n_pages]
+        k, v = scatter_pages(self.cache.k, self.cache.v,
+                             jnp.asarray(ids), entry.k, entry.v)
+        self.cache = KVCache(k=k, v=v)
+        self.counters["host_kv_restored_pages_total"] += n_pages
+        n = len(req.resume_tokens())
+        slot.prefilling = False
+        slot.prefill_tokens = []
+        slot.position = entry.written
+        slot.remaining = min(
+            req.params.max_tokens - len(req.output_tokens),
+            self.cfg.max_model_len - entry.written,
+            self._capacity_tokens - entry.written)
+        self.positions[free_slot] = entry.written
+        self.active[free_slot] = True
+        # the pending input token is the last emitted output (its KV is
+        # the next decode write); nothing new is emitted here
+        self.last_tokens[free_slot] = req.output_tokens[-1]
+        logger.debug("restored %s: %d pages, resuming at %d",
+                     req.req_id, n_pages, entry.written)
+        return True
 
     def _newest_slot(self) -> Optional[int]:
         candidates = [i for i, s in enumerate(self.slots)
@@ -976,5 +1074,7 @@ class InferenceEngine:
                     prompt_tokens=list(req.prompt_tokens),
                     first_token=req.output_tokens[0]))
             req.out.put(None)
+            if self.host_kv is not None:
+                self.host_kv.discard(req.req_id)
             self._evict_slot(slot_idx, commit=True)
             self.counters["requests_finished_total"] += 1

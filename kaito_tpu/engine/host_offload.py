@@ -1,0 +1,109 @@
+"""Host-RAM KV offload tier (the LMCache analogue).
+
+The reference sizes a CPU KV-cache tier per TP rank and hands vLLM an
+LMCacheConnectorV1 (`/root/reference/presets/workspace/inference/vllm/
+inference_api.py:503-556`); on 16 GiB v5e chips the equivalent matters
+even more.  TPU-native design: the engine's preemption path (newest
+sequence yields its pages when the pool runs dry) gains a spill/restore
+fast path — the victim's *written* KV pages are copied to a host-side
+LRU pool with an async `jax.device_put` onto the CPU backend (the
+transfer is enqueued before any later donating step touches the buffer,
+so D2H overlaps decode), and re-admission scatters them back into
+freshly acquired pages instead of recomputing the whole prefix.
+
+Dropping an entry is always safe: resume falls back to the recompute
+path the scheduler already has.  v1 scope: single-chip engines (no
+TP/PP cache layouts); the multi-chip spill follows the same page-id
+contract later.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import time
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+import jax
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class HostKVEntry:
+    k: jax.Array          # [L, n_pages, H, ps, D] on the host backend
+    v: jax.Array
+    written: int          # tokens whose KV the pages hold
+    nbytes: int
+
+
+class HostKVPool:
+    """LRU byte-budgeted store of spilled sequences, keyed by req_id."""
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = max_bytes
+        self.used_bytes = 0
+        self._entries: "collections.OrderedDict[str, HostKVEntry]" = \
+            collections.OrderedDict()
+        try:
+            self._host_dev = jax.devices("cpu")[0]
+        except RuntimeError:
+            self._host_dev = None
+        self.spilled_pages = 0
+        self.restored_pages = 0
+        self.evicted_entries = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def put(self, req_id: str, k, v, written: int) -> bool:
+        """Store a spilled sequence; returns False if it can never fit."""
+        self.discard(req_id)   # same-key overwrite must not double-count
+        nbytes = k.nbytes + v.nbytes
+        if nbytes > self.max_bytes:
+            return False
+        while self.used_bytes + nbytes > self.max_bytes and self._entries:
+            _, old = self._entries.popitem(last=False)
+            self.used_bytes -= old.nbytes
+            self.evicted_entries += 1
+        if self._host_dev is not None:
+            # async D2H: enqueued ahead of any later donating step
+            k = jax.device_put(k, self._host_dev)
+            v = jax.device_put(v, self._host_dev)
+        self._entries[req_id] = HostKVEntry(k=k, v=v, written=written,
+                                            nbytes=nbytes)
+        self.used_bytes += nbytes
+        self.spilled_pages += k.shape[1]
+        return True
+
+    def has(self, req_id: str) -> bool:
+        return req_id in self._entries
+
+    def pop(self, req_id: str) -> Optional[HostKVEntry]:
+        entry = self._entries.pop(req_id, None)
+        if entry is not None:
+            self.used_bytes -= entry.nbytes
+            self.restored_pages += entry.k.shape[1]
+        return entry
+
+    def discard(self, req_id: str) -> None:
+        entry = self._entries.pop(req_id, None)
+        if entry is not None:
+            self.used_bytes -= entry.nbytes
+
+
+@jax.jit
+def gather_pages(cache_k, cache_v, ids):
+    """Copy pages out of the pools: [L, P, H, ps, D] -> [L, n, ...]
+    (specializes per page count — bounded by pages_per_seq)."""
+    return cache_k[:, ids], cache_v[:, ids]
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def scatter_pages(cache_k, cache_v, ids, k_pages, v_pages):
+    """Write spilled pages back into freshly acquired page slots."""
+    return (cache_k.at[:, ids].set(k_pages.astype(cache_k.dtype)),
+            cache_v.at[:, ids].set(v_pages.astype(cache_v.dtype)))

@@ -167,6 +167,25 @@ class EngineMetrics:
                   max(engine.allocator.num_pages - 1, 1))
             Gauge("kaito:kv_pages_total", "Total KV pages", r,
                   fn=lambda: engine.allocator.num_pages - 1)
+            # page size gauge: the benchmark probe derives concurrency
+            # from KV capacity and must not hardcode the page size
+            Gauge("kaito:kv_page_size", "Tokens per KV page", r,
+                  fn=lambda: engine.cfg.page_size)
+            Gauge("kaito:num_preemptions_total", "Sequences preempted", r,
+                  fn=lambda: engine.counters["preemptions_total"])
+            Gauge("kaito:prefix_cached_tokens_total",
+                  "Prompt tokens served from the prefix cache", r,
+                  fn=lambda: engine.counters["prefix_cached_tokens_total"])
+            Gauge("kaito:host_kv_spilled_pages_total",
+                  "KV pages spilled to the host offload tier", r,
+                  fn=lambda: engine.counters["host_kv_spilled_pages_total"])
+            Gauge("kaito:host_kv_restored_pages_total",
+                  "KV pages restored from the host offload tier", r,
+                  fn=lambda: engine.counters["host_kv_restored_pages_total"])
+            Gauge("kaito:host_kv_bytes_used",
+                  "Bytes held by the host KV offload tier", r,
+                  fn=lambda: engine.host_kv.used_bytes
+                  if engine.host_kv else 0)
 
     def observe_request(self, req) -> None:
         if req.first_token_time:

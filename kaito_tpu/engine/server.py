@@ -407,6 +407,12 @@ def main(argv=None):
     ap.add_argument("--pd-source-allowlist",
                     default=os.environ.get("KAITO_PD_ALLOWLIST", ""))
     ap.add_argument("--kaito-disable-rate-limit", action="store_true")
+    ap.add_argument("--kaito-kv-cache-cpu-memory-utilization", type=float,
+                    default=float(os.environ.get(
+                        "KAITO_KV_CPU_MEM_UTIL", "0")),
+                    help="fraction of host RAM for the KV offload tier "
+                         "(0 disables; reference contract "
+                         "inference_api.py:503-556)")
     ap.add_argument("--max-queue-len", type=int, default=256)
     args = ap.parse_args(argv)
 
@@ -432,6 +438,9 @@ def main(argv=None):
         pd_enabled=args.pd_enabled,
         pd_source_allowlist=args.pd_source_allowlist,
         disable_rate_limit=args.kaito_disable_rate_limit,
+        host_kv_offload_bytes=int(
+            args.kaito_kv_cache_cpu_memory_utilization
+            * os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES")),
         max_queue_len=args.max_queue_len,
     )
     if args.kaito_config_file:

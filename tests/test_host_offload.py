@@ -1,0 +1,105 @@
+"""Host-RAM KV offload tier: preempted sequences spill their written
+pages to host and resume by restore instead of recompute (the LMCache
+analogue, reference inference_api.py:503-556)."""
+
+import numpy as np
+import pytest
+
+from kaito_tpu.engine.config import EngineConfig
+from kaito_tpu.engine.engine import InferenceEngine, SamplingParams
+
+BASE = dict(model="tiny-llama-test", max_model_len=256, page_size=16,
+            max_num_seqs=2, max_pages=10, dtype="float32",
+            kv_dtype="float32", prefill_buckets=(32, 64, 128), seed=0,
+            enable_prefix_caching=False)
+
+
+def _greedy(n):
+    return SamplingParams(max_tokens=n, temperature=0.0, ignore_eos=True)
+
+
+def _run_pair(cfg):
+    """Two sequences whose combined growth exceeds the 9-page pool."""
+    eng = InferenceEngine(cfg)
+    eng.start()
+    try:
+        ra = eng.submit([40, 41, 42] * 11, _greedy(100))
+        rb = eng.submit([50, 51, 52] * 11, _greedy(40))
+        a_out = list(ra.stream())
+        b_out = list(rb.stream())
+    finally:
+        eng.stop()
+    return eng, a_out, b_out
+
+
+def test_spill_restore_resumes_without_recompute():
+    solo = InferenceEngine(EngineConfig(**BASE))
+    solo.start()
+    try:
+        b_ref = list(solo.submit([50, 51, 52] * 11, _greedy(40)).stream())
+    finally:
+        solo.stop()
+
+    cfg = EngineConfig(**BASE, host_kv_offload_bytes=256 * 2**20)
+    eng, a_out, b_out = _run_pair(cfg)
+    assert len(a_out) == 100 and len(b_out) == 40
+    assert b_out == b_ref                       # greedy survives the spill
+    assert eng.counters["preemptions_total"] >= 1
+    assert eng.counters["host_kv_spilled_pages_total"] >= 1
+    assert eng.counters["host_kv_restored_pages_total"] >= 1
+    # restore path skipped the recompute: no prefill step covers the
+    # preempted sequence's accumulated prompt+output
+    recompute = InferenceEngine(EngineConfig(**BASE))   # offload off
+    recompute.start()
+    try:
+        ra = recompute.submit([40, 41, 42] * 11, _greedy(100))
+        rb = recompute.submit([50, 51, 52] * 11, _greedy(40))
+        list(ra.stream()); list(rb.stream())
+    finally:
+        recompute.stop()
+    assert recompute.counters["preemptions_total"] >= 1
+    assert eng.counters["prefill_steps_total"] < \
+        recompute.counters["prefill_steps_total"]
+    assert eng.allocator.available == eng.allocator.num_pages - 1
+
+
+def test_lru_eviction_falls_back_to_recompute():
+    """A pool too small for any entry drops the spill; resume recomputes
+    and stays correct."""
+    solo = InferenceEngine(EngineConfig(**BASE))
+    solo.start()
+    try:
+        b_ref = list(solo.submit([50, 51, 52] * 11, _greedy(40)).stream())
+    finally:
+        solo.stop()
+    cfg = EngineConfig(**BASE, host_kv_offload_bytes=1024)  # ~nothing fits
+    eng, a_out, b_out = _run_pair(cfg)
+    assert len(a_out) == 100 and len(b_out) == 40
+    assert b_out == b_ref
+    assert eng.counters["host_kv_restored_pages_total"] == 0
+
+
+def test_host_pool_roundtrip_and_lru():
+    import jax.numpy as jnp
+
+    from kaito_tpu.engine.host_offload import HostKVPool
+
+    k = jnp.arange(2 * 3 * 1 * 4 * 2, dtype=jnp.float32).reshape(2, 3, 1, 4, 2)
+    v = k + 100
+    pool = HostKVPool(max_bytes=4 * k.nbytes + 4 * v.nbytes)
+    assert pool.put("a", k, v, written=10)
+    assert pool.has("a")
+    entry = pool.pop("a")
+    assert entry is not None and entry.written == 10
+    np.testing.assert_array_equal(np.asarray(entry.k), np.asarray(k))
+    np.testing.assert_array_equal(np.asarray(entry.v), np.asarray(v))
+    assert not pool.has("a") and pool.used_bytes == 0
+    # LRU: budget of 2 entries; third insert evicts the oldest
+    pool2 = HostKVPool(max_bytes=2 * (k.nbytes + v.nbytes))
+    pool2.put("x", k, v, 1)
+    pool2.put("y", k, v, 2)
+    pool2.put("z", k, v, 3)
+    assert not pool2.has("x") and pool2.has("y") and pool2.has("z")
+    assert pool2.evicted_entries == 1
+    # oversized entry is refused outright
+    assert not HostKVPool(max_bytes=8).put("big", k, v, 1)
