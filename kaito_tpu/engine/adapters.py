@@ -1,16 +1,25 @@
 """Serving-side LoRA adapter loading.
 
 Counterpart of the reference wrapper's ``--kaito-adapters-dir``
-discovery + vLLM LoRARequest plumbing (``inference_api.py:417``): at
-startup the engine scans the adapter directory, loads our adapter
-artifacts (kaito_tpu.tuning.lora format), and applies them — merged
-into the base weights for zero-overhead serving.
+discovery + vLLM LoRARequest plumbing (``inference_api.py:417-498``):
+at startup the engine scans the adapter directory and loads every
+adapter (kaito_tpu.tuning.lora format) into STACKED per-target buffers
+— ``[L, n_adapters+1, in, r_max]`` factors that ride the layer scan —
+so each request selects its adapter by index at runtime (index 0 is the
+all-zeros base).  Requests choose an adapter with the ``model`` field,
+exactly like the reference serves adapters as selectable models.
+
+``apply_adapters_to_params`` (merge-into-base) remains for the TP/PP
+paths where the stacked buffers aren't wired yet.
 """
 
 from __future__ import annotations
 
 import logging
 import os
+
+import jax.numpy as jnp
+import numpy as np
 
 logger = logging.getLogger(__name__)
 
@@ -28,6 +37,76 @@ def discover_adapters(adapters_dir: str) -> dict[str, str]:
         ):
             found[name] = path
     return found
+
+
+def load_adapter_stacks(model, adapters_dir: str,
+                        base_model: str = "") -> tuple[dict, dict]:
+    """Build the serve-time stacked LoRA buffers.
+
+    Returns ``(serve_lora, name_to_index)`` where serve_lora is
+    ``{group: {f"{t}_a": [L, n+1, in, rmax], f"{t}_b": [L, n+1, rmax, out]}}``
+    (adapter 0 all-zeros = base model; alpha/r scaling folded into B)
+    and name_to_index maps adapter names to their runtime index.
+    Empty dicts when no adapters are present.
+    """
+    from kaito_tpu.tuning.lora import load_adapter
+
+    if model.is_mla:
+        # the MLA layer body has no multi-LoRA sites yet; refusing to
+        # load keeps selection an explicit error instead of a silent
+        # base-model response
+        if discover_adapters(adapters_dir):
+            logger.warning("per-request adapters are not supported on MLA "
+                           "models yet; adapters in %s ignored", adapters_dir)
+        return {}, {}
+    found = discover_adapters(adapters_dir)
+    loaded = []
+    for name, path in found.items():
+        try:
+            adapter, cfg, base = load_adapter(path)
+        except Exception:
+            logger.exception("skipping unreadable adapter %s", name)
+            continue
+        if base and base_model and base != base_model:
+            logger.warning("adapter %s targets base %s, serving %s",
+                           name, base, base_model)
+        loaded.append((name, adapter, cfg))
+    if not loaded:
+        return {}, {}
+
+    rmax = max(cfg.r for _, _, cfg in loaded)
+    n = len(loaded)
+    serve_lora: dict = {}
+    for g in model.groups:
+        specs = model._layer_specs(g.moe)
+        if g.moe:
+            continue       # expert stacks: adapters target dense layers
+        group_buf: dict = {}
+        for t in ("q", "k", "v", "o", "gate", "up", "down"):
+            if t not in specs:
+                continue
+            in_dim, out_dim = specs[t][0]
+            key_a = f"{g.name}/{t}_lora_a"
+            key_b = f"{g.name}/{t}_lora_b"
+            if not any(key_a in ad for _, ad, _ in loaded):
+                continue
+            A = np.zeros((g.count, n + 1, in_dim, rmax), np.float32)
+            B = np.zeros((g.count, n + 1, rmax, out_dim), np.float32)
+            for i, (name, ad, cfg) in enumerate(loaded):
+                if key_a not in ad:
+                    continue
+                a = np.asarray(ad[key_a], np.float32)     # [L, in, r]
+                b = np.asarray(ad[key_b], np.float32)     # [L, r, out]
+                A[:, i + 1, :, :a.shape[-1]] = a
+                B[:, i + 1, :b.shape[1], :] = b * cfg.scaling
+            group_buf[f"{t}_a"] = jnp.asarray(A, model.dtype)
+            group_buf[f"{t}_b"] = jnp.asarray(B, model.dtype)
+        if group_buf:
+            serve_lora[g.name] = group_buf
+    name_to_index = {name: i + 1 for i, (name, _, _) in enumerate(loaded)}
+    logger.info("loaded %d adapters for per-request serving: %s (rmax=%d)",
+                n, list(name_to_index), rmax)
+    return serve_lora, name_to_index
 
 
 def apply_adapters_to_params(model, params, adapters_dir: str) -> dict:

@@ -76,6 +76,7 @@ class Request:
     aborted: bool = False
     preemptions: int = 0
     prompt_counted: bool = False   # metrics: prompt tokens counted once
+    adapter: str = ""              # per-request LoRA adapter name
 
     def resume_tokens(self) -> list[int]:
         """Prompt plus everything generated so far — what a preempted
@@ -186,11 +187,27 @@ class InferenceEngine:
                     2 * self.cache.k.nbytes / 2**30)
 
         self.params = params if params is not None else self._init_params()
+        self.adapter_index: dict[str, int] = {}
+        self.adapters_merged = False
         if cfg.adapters_dir:
-            from kaito_tpu.engine.adapters import apply_adapters_to_params
+            if self.mesh is not None or self.pp_exec is not None:
+                # stacked per-request buffers are single-chip this round;
+                # TP/PP keep the round-1 merge-into-base semantics
+                from kaito_tpu.engine.adapters import apply_adapters_to_params
 
-            self.params = apply_adapters_to_params(self.model, self.params,
-                                                   cfg.adapters_dir)
+                logger.warning("TP/PP engine: adapters merge into base "
+                               "weights (per-request routing is "
+                               "single-chip this round)")
+                self.params = apply_adapters_to_params(
+                    self.model, self.params, cfg.adapters_dir)
+                self.adapters_merged = True
+            else:
+                from kaito_tpu.engine.adapters import load_adapter_stacks
+
+                serve_lora, self.adapter_index = load_adapter_stacks(
+                    self.model, cfg.adapters_dir, self.md.name)
+                if serve_lora:
+                    self.params = {**self.params, "serve_lora": serve_lora}
         if self.pp_exec is not None:
             self.params = self.pp_exec.stage_params(self.params)
         self.prefix_cache = None
@@ -228,6 +245,7 @@ class InferenceEngine:
         self.active = np.zeros((S,), bool)
         self.sampling = SamplingState.create(S, cfg.seed)
         self.last_tokens = np.zeros((S,), np.int32)
+        self.slot_adapters = np.zeros((S,), np.int32)  # 0 = base model
 
         self.waiting: "collections.deque[Request]" = collections.deque()
         self._waiting_count = 0
@@ -396,13 +414,15 @@ class InferenceEngine:
                      if self.pp_exec is not None else None)
 
         @partial(jax.jit, donate_argnums=(1, 2))
-        def decode_step(params, cache, sampling, tokens, positions, page_tables, active):
+        def decode_step(params, cache, sampling, tokens, positions,
+                        page_tables, active, adapter_ids):
             if pp_decode is not None:
                 cache, logits = pp_decode(params, cache, tokens, positions,
                                           page_tables, active)
             else:
                 cache, logits = model.decode(params, cache, tokens, positions,
-                                             page_tables, active)
+                                             page_tables, active,
+                                             adapter_ids=adapter_ids)
             next_tokens, sampling = sample(logits, sampling)
             return cache, sampling, next_tokens
 
@@ -416,12 +436,14 @@ class InferenceEngine:
                           if self.pp_exec is not None else None)
 
             @partial(jax.jit, donate_argnums=(1,))
-            def prefill_step(params, cache, tokens, true_lens, page_tables):
+            def prefill_step(params, cache, tokens, true_lens, page_tables,
+                             adapter_ids):
                 if pp_prefill is not None:
                     return pp_prefill(params, cache, tokens, true_lens,
                                       page_tables)
                 cache, logits, _ = model.prefill(params, cache, tokens,
-                                                 true_lens, page_tables)
+                                                 true_lens, page_tables,
+                                                 adapter_ids=adapter_ids)
                 return cache, logits
 
             fn = prefill_step
@@ -438,13 +460,14 @@ class InferenceEngine:
 
             @partial(jax.jit, donate_argnums=(1,))
             def prefill_ctx(params, cache, tokens, true_lens, page_tables,
-                            start_pos):
+                            start_pos, adapter_ids):
                 if pp_prefill is not None:
                     return pp_prefill(params, cache, tokens, true_lens,
                                       page_tables, start_pos)
                 cache, logits, _ = model.prefill(params, cache, tokens,
                                                  true_lens, page_tables,
-                                                 start_pos=start_pos)
+                                                 start_pos=start_pos,
+                                                 adapter_ids=adapter_ids)
                 return cache, logits
 
             fn = prefill_ctx
@@ -484,10 +507,13 @@ class InferenceEngine:
 
     def submit(self, prompt_tokens: list[int], params: SamplingParams,
                req_id: Optional[str] = None,
-               export_kv: bool = False) -> Request:
+               export_kv: bool = False, adapter: str = "") -> Request:
         self._validate_submit(prompt_tokens, params)
+        if adapter and adapter not in self.adapter_index:
+            raise ValueError(f"unknown adapter {adapter!r}")
         req = Request(req_id or f"req-{self.counters['requests_total']}",
-                      list(prompt_tokens), params, export_kv=export_kv)
+                      list(prompt_tokens), params, export_kv=export_kv,
+                      adapter=adapter)
         with self._lock:
             self.counters["requests_total"] += 1
             self._waiting_count += 1
@@ -588,9 +614,11 @@ class InferenceEngine:
         slot = self.slots[slot_idx]
         req = slot.request
         if self.prefix_cache is not None:
-            tokens = [] if req.kv_import is not None else \
-                req.resume_tokens()[:slot.written]
-            if commit and req.kv_import is None:
+            # adapter KV must never enter the shared tree (it embeds the
+            # adapter's k/v deltas); imports are foreign bytes
+            exclusive = req.kv_import is not None or bool(req.adapter)
+            tokens = [] if exclusive else req.resume_tokens()[:slot.written]
+            if commit and not exclusive:
                 self.prefix_cache.release(tokens, slot.pages)
             else:
                 self.prefix_cache.release_uncommitted(tokens, slot.pages)
@@ -603,6 +631,7 @@ class InferenceEngine:
         slot.prefill_pos = 0
         slot.position = 0
         slot.remaining = 0
+        self.slot_adapters[slot_idx] = 0
         self.active[slot_idx] = False
 
     def _fail_request(self, req: Request):
@@ -726,13 +755,14 @@ class InferenceEngine:
             self._requeue_front(req)
             return False
         if self.prefix_cache is not None:
-            # PD imports carry foreign KV bytes, and spilled sequences
-            # scatter host pages over their slots: both acquire
-            # EXCLUSIVE pages (empty-token acquire shares nothing) so
-            # they can neither overwrite shared pages nor inherit a
-            # cached prefix they would immediately clobber
-            acquire_tokens = [] if (req.kv_import is not None or has_spill) \
-                else tokens
+            # PD imports carry foreign KV bytes, spilled sequences
+            # scatter host pages over their slots, and adapter requests
+            # produce adapter-flavored KV (k/v deltas differ per
+            # adapter): all acquire EXCLUSIVE pages (empty-token acquire
+            # shares nothing) so they neither overwrite shared pages nor
+            # inherit a cached prefix computed under different weights
+            acquire_tokens = [] if (req.kv_import is not None or has_spill
+                                    or req.adapter) else tokens
             res = self.prefix_cache.acquire(acquire_tokens, n + 1)
             if res is None:
                 self._requeue_front(req)
@@ -756,6 +786,7 @@ class InferenceEngine:
         slot.pages = list(pages)
         self._admit_seq += 1
         slot.seq = self._admit_seq
+        self.slot_adapters[free_slot] = self.adapter_index.get(req.adapter, 0)
         # stage prefill bookkeeping BEFORE anything that can raise, so a
         # failure path releases exactly the acquired token prefix (shared
         # refcounts included) via slot.written
@@ -815,13 +846,15 @@ class InferenceEngine:
         bucket = self._bucket(m)
         ctoks = np.zeros((1, bucket), np.int32)
         ctoks[0, :m] = chunk
+        aid = jnp.asarray(self.slot_adapters[i:i + 1])
         try:
             if pos == 0 and m == n:
                 fn = self._prefill_fn(bucket)
                 self.cache, logits = fn(self.params, self.cache,
                                         jnp.asarray(ctoks),
                                         jnp.asarray([m], np.int32),
-                                        jnp.asarray(self.page_tables[i][None]))
+                                        jnp.asarray(self.page_tables[i][None]),
+                                        aid)
             else:
                 # chunk attends over the paged history (cached prefix +
                 # earlier chunks) — bounds per-step latency for long
@@ -831,7 +864,8 @@ class InferenceEngine:
                                         jnp.asarray(ctoks),
                                         jnp.asarray([m], np.int32),
                                         jnp.asarray(self.page_tables[i][None]),
-                                        jnp.asarray([pos], np.int32))
+                                        jnp.asarray([pos], np.int32),
+                                        aid)
         except Exception:
             logger.exception("prefill failed for %s", req.req_id)
             self._evict_slot(i, commit=False)
@@ -1029,7 +1063,8 @@ class InferenceEngine:
             jnp.asarray(self.last_tokens),
             jnp.asarray(self.positions),
             jnp.asarray(self.page_tables),
-            jnp.asarray(self.active))
+            jnp.asarray(self.active),
+            jnp.asarray(self.slot_adapters))
         self.cache = cache
         self.sampling = sampling
         self.counters["decode_steps_total"] += 1

@@ -330,7 +330,8 @@ class TransformerLM:
     # ------------------------------------------------------------------
 
     def _attn_qkv(self, x: jax.Array, p: dict, positions: jax.Array,
-                  window: Optional[jax.Array]):
+                  window: Optional[jax.Array], lora: Optional[dict] = None,
+                  lora_ids: Optional[jax.Array] = None):
         """Project to q/k/v heads with norms+rope applied.
 
         x: [B, T, E]; positions: [B, T] absolute positions.
@@ -338,9 +339,12 @@ class TransformerLM:
         a = self.arch
         B, T, _ = x.shape
         ls = self.lora_scaling
-        q = nn.linear(x, p["q"]) + nn.lora_delta(x, p, "q", ls)
-        k = nn.linear(x, p["k"]) + nn.lora_delta(x, p, "k", ls)
-        v = nn.linear(x, p["v"]) + nn.lora_delta(x, p, "v", ls)
+        q = nn.linear(x, p["q"]) + nn.lora_delta(x, p, "q", ls) \
+            + nn.multi_lora_delta(x, lora, "q", lora_ids)
+        k = nn.linear(x, p["k"]) + nn.lora_delta(x, p, "k", ls) \
+            + nn.multi_lora_delta(x, lora, "k", lora_ids)
+        v = nn.linear(x, p["v"]) + nn.lora_delta(x, p, "v", ls) \
+            + nn.multi_lora_delta(x, lora, "v", lora_ids)
         if "q_bias" in p:
             q, k, v = q + p["q_bias"], k + p["k_bias"], v + p["v_bias"]
         q = q.reshape(B, T, a.num_heads, a.head_dim)
@@ -358,13 +362,16 @@ class TransformerLM:
         k = nn.apply_rope(k, positions, inv_freq, a.head_dim)
         return q, k, v
 
-    def _mlp(self, x: jax.Array, p: dict, moe: bool) -> jax.Array:
+    def _mlp(self, x: jax.Array, p: dict, moe: bool,
+             lora: Optional[dict] = None,
+             lora_ids: Optional[jax.Array] = None) -> jax.Array:
         if moe:
             B, T, E = x.shape
             fn = nn.moe_mlp_ragged if self.moe_impl == "ragged" else nn.moe_mlp
             y = fn(x.reshape(B * T, E), p, self.arch)
             return y.reshape(B, T, E)
-        return nn.mlp(x, p, self.arch, self.lora_scaling)
+        return nn.mlp(x, p, self.arch, self.lora_scaling,
+                      serve_lora=lora, lora_ids=lora_ids)
 
     def _norm(self, x, p, name):
         if self.arch.norm_type == "layernorm":
@@ -373,7 +380,7 @@ class TransformerLM:
 
     def _layer(self, x, p, ck, cv, window, moe, mode, *,
                positions, page_tables, lengths, true_lens, active,
-               start_pos=None):
+               start_pos=None, lora=None, lora_ids=None):
         """One transformer block. Returns (x, ck, cv)."""
         a = self.arch
         B, T, E = x.shape
@@ -388,7 +395,8 @@ class TransformerLM:
             x = x + attn_out
             h2 = self._norm(x, p, "mlp_norm")
             return x + self._mlp(h2, p, moe), ck, cv
-        q, k_new, v_new = self._attn_qkv(h, p, positions, window)
+        q, k_new, v_new = self._attn_qkv(h, p, positions, window,
+                                         lora=lora, lora_ids=lora_ids)
         ps = ck.shape[-2]
 
         if mode == "prefill":
@@ -435,19 +443,20 @@ class TransformerLM:
                     sliding_window=window, logit_softcap=a.attn_logit_softcap)
             out = out[:, None]
         o_in = out.reshape(B, T, a.num_heads * a.head_dim)
-        attn_out = nn.linear(o_in, p["o"]) + nn.lora_delta(o_in, p, "o", self.lora_scaling)
+        attn_out = nn.linear(o_in, p["o"]) + nn.lora_delta(o_in, p, "o", self.lora_scaling) \
+            + nn.multi_lora_delta(o_in, lora, "o", lora_ids)
         if "o_bias" in p:
             attn_out = attn_out + p["o_bias"]
 
         if a.parallel_residual:
-            mlp_out = self._mlp(h, p, moe)
+            mlp_out = self._mlp(h, p, moe, lora=lora, lora_ids=lora_ids)
             return x + attn_out + mlp_out, ck, cv
 
         if a.pre_post_norm:
             attn_out = self._norm(attn_out, p, "post_attn_norm")
         x = x + attn_out
         h2 = self._norm(x, p, "mlp_norm")
-        mlp_out = self._mlp(h2, p, moe)
+        mlp_out = self._mlp(h2, p, moe, lora=lora, lora_ids=lora_ids)
         if a.pre_post_norm:
             mlp_out = self._norm(mlp_out, p, "post_mlp_norm")
         return x + mlp_out, ck, cv
@@ -458,7 +467,8 @@ class TransformerLM:
 
     def _run_layers(self, params, cache: Optional[KVCache], x, mode, *,
                     positions, page_tables, lengths, true_lens, active,
-                    remat: bool = False, start_pos=None):
+                    remat: bool = False, start_pos=None, adapter_ids=None):
+        serve_lora = params.get("serve_lora") if mode != "train" else None
         new_k, new_v = [], []
         for g in self.groups:
             stack = params[g.name]
@@ -479,22 +489,29 @@ class TransformerLM:
 
             ck_g = cache.k[g.start:g.start + g.count]
             cv_g = cache.v[g.start:g.start + g.count]
+            # per-request adapters ride the scan as an extra [L, n, ...]
+            # stack (None for groups without one, e.g. MoE)
+            lora_g = serve_lora.get(g.name) if serve_lora else None
+            has_lora = bool(lora_g)
 
-            def body(carry, xs, moe=g.moe):
+            def body(carry, xs, moe=g.moe, has_lora=has_lora):
                 h = carry
-                if flags is None:
-                    p, ck_l, cv_l = xs
-                    window = None
-                else:
-                    p, ck_l, cv_l, window = xs
+                items = list(xs)
+                p, ck_l, cv_l = items[0], items[1], items[2]
+                lora_l = items[3] if has_lora else None
+                window = items[-1] if flags is not None else None
                 h, ck_l, cv_l = self._layer(
                     h, p, ck_l, cv_l, window, moe, mode,
                     positions=positions, page_tables=page_tables,
                     lengths=lengths, true_lens=true_lens, active=active,
-                    start_pos=start_pos)
+                    start_pos=start_pos, lora=lora_l, lora_ids=adapter_ids)
                 return h, (ck_l, cv_l)
 
-            xs = (stack, ck_g, cv_g) if flags is None else (stack, ck_g, cv_g, flags)
+            xs = (stack, ck_g, cv_g)
+            if has_lora:
+                xs = xs + (lora_g,)
+            if flags is not None:
+                xs = xs + (flags,)
             x, (ck_new, cv_new) = jax.lax.scan(body, x, xs)
             new_k.append(ck_new)
             new_v.append(cv_new)
@@ -564,7 +581,7 @@ class TransformerLM:
         return logits[..., : self.arch.vocab_size]
 
     def prefill(self, params, cache: KVCache, tokens, true_lens, page_tables,
-                start_pos=None):
+                start_pos=None, adapter_ids=None):
         """Process prompts (or prompt suffixes when ``start_pos`` marks a
         cached/chunked prefix already present in the pages).
 
@@ -579,14 +596,14 @@ class TransformerLM:
         x, cache = self._run_layers(
             params, cache, x, "prefill", positions=positions,
             page_tables=page_tables, lengths=true_lens, true_lens=true_lens,
-            active=None, start_pos=start_pos)
+            active=None, start_pos=start_pos, adapter_ids=adapter_ids)
         x = self._norm(x, params, "final_norm")
         last = jnp.take_along_axis(
             x, (true_lens - 1)[:, None, None].astype(jnp.int32), axis=1)[:, 0]
         return cache, self._logits(params, last), last
 
     def decode(self, params, cache: KVCache, tokens, positions, page_tables,
-               active=None):
+               active=None, adapter_ids=None):
         """One decode step for a batch of slots.
 
         tokens: [B] last sampled token; positions: [B] their positions;
@@ -598,7 +615,7 @@ class TransformerLM:
         x, cache = self._run_layers(
             params, cache, x, "decode", positions=pos2,
             page_tables=page_tables, lengths=positions + 1, true_lens=None,
-            active=active)
+            active=active, adapter_ids=adapter_ids)
         x = self._norm(x, params, "final_norm")
         return cache, self._logits(params, x[:, 0])
 

@@ -75,6 +75,7 @@ def _wire_request(req: Request) -> dict:
         "stop": list(p.stop_token_ids),
         "seed": p.seed,
         "ignore_eos": p.ignore_eos,
+        "adapter": req.adapter,
     }
 
 
@@ -84,7 +85,8 @@ def _unwire_request(item: dict) -> Request:
         top_k=item["top_k"], top_p=item["top_p"],
         stop_token_ids=tuple(item["stop"]), seed=item["seed"],
         ignore_eos=item["ignore_eos"])
-    return Request(item["req_id"], list(item["tokens"]), params)
+    return Request(item["req_id"], list(item["tokens"]), params,
+                   adapter=item.get("adapter", ""))
 
 
 class MultiHostEngine(InferenceEngine):
@@ -106,12 +108,14 @@ class MultiHostEngine(InferenceEngine):
         self._abort_requested: set[str] = set()
 
     def submit(self, prompt_tokens, params, req_id=None,
-               export_kv=False) -> Request:
+               export_kv=False, adapter: str = "") -> Request:
         if not self.is_leader:
             raise RuntimeError("submit() is leader-only; workers receive "
                                "requests via the step broadcast")
         if export_kv:
             raise ValueError("PD export is single-host per role")
+        if adapter and adapter not in self.adapter_index:
+            raise ValueError(f"unknown adapter {adapter!r}")
         self._validate_submit(prompt_tokens, params)
         with self._lock:
             self.counters["requests_total"] += 1
@@ -124,7 +128,7 @@ class MultiHostEngine(InferenceEngine):
                 params = dataclasses.replace(
                     params, seed=self.counters["requests_total"])
             req = Request(req_id or f"req-{self.counters['requests_total']}",
-                          list(prompt_tokens), params)
+                          list(prompt_tokens), params, adapter=adapter)
             self._staged.append(req)
         self._wake.set()
         return req

@@ -39,6 +39,26 @@ def lora_delta(x: jax.Array, p: dict, name: str, scaling: float) -> jax.Array:
     return ((x @ a) @ b) * scaling
 
 
+def multi_lora_delta(x: jax.Array, lora: Optional[dict], name: str,
+                     ids: Optional[jax.Array]):
+    """Per-request batched LoRA: each row of the batch applies ITS OWN
+    adapter's low-rank update (adapter 0 is the all-zeros base).
+
+    The serving counterpart of the reference's per-request vLLM
+    LoRARequest routing (inference_api.py:417-498).  x: [B, T, E];
+    lora[f"{name}_a"]: [n_adapters, E, r] (per-layer slice of the scan
+    stack); ids: [B] int32.  Scaling is folded into B at load time.
+    """
+    if lora is None or ids is None:
+        return 0.0
+    a = lora.get(f"{name}_a")
+    if a is None:
+        return 0.0
+    b = lora[f"{name}_b"]
+    ax = jnp.einsum("bte,ber->btr", x, a[ids])
+    return jnp.einsum("btr,bro->bto", ax, b[ids])
+
+
 def rms_norm(x: jax.Array, scale: jax.Array, eps: float, offset: bool) -> jax.Array:
     dtype = x.dtype
     x32 = x.astype(jnp.float32)
@@ -136,19 +156,25 @@ def activation(x: jax.Array, name: str) -> jax.Array:
     raise ValueError(f"unknown activation {name!r}")
 
 
-def mlp(x: jax.Array, p: dict, arch: ModelArch, lora_scaling: float = 0.0) -> jax.Array:
+def mlp(x: jax.Array, p: dict, arch: ModelArch, lora_scaling: float = 0.0,
+        serve_lora: Optional[dict] = None,
+        lora_ids: Optional[jax.Array] = None) -> jax.Array:
     """Gated (SwiGLU/GeGLU) or classic 2-matrix MLP."""
     if arch.gated_mlp:
-        gate = activation(linear(x, p["gate"]) + lora_delta(x, p, "gate", lora_scaling),
+        gate = activation(linear(x, p["gate"]) + lora_delta(x, p, "gate", lora_scaling)
+                          + multi_lora_delta(x, serve_lora, "gate", lora_ids),
                           arch.hidden_act)
-        up = linear(x, p["up"]) + lora_delta(x, p, "up", lora_scaling)
+        up = linear(x, p["up"]) + lora_delta(x, p, "up", lora_scaling) \
+            + multi_lora_delta(x, serve_lora, "up", lora_ids)
         h = gate * up
     else:
-        h = linear(x, p["up"]) + lora_delta(x, p, "up", lora_scaling)
+        h = linear(x, p["up"]) + lora_delta(x, p, "up", lora_scaling) \
+            + multi_lora_delta(x, serve_lora, "up", lora_ids)
         if "up_bias" in p:
             h = h + p["up_bias"]
         h = activation(h, arch.hidden_act)
-    out = linear(h, p["down"]) + lora_delta(h, p, "down", lora_scaling)
+    out = linear(h, p["down"]) + lora_delta(h, p, "down", lora_scaling) \
+        + multi_lora_delta(h, serve_lora, "down", lora_ids)
     if "down_bias" in p:
         out = out + p["down_bias"]
     return out

@@ -252,6 +252,23 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         stop_strs = [stop] if isinstance(stop, str) else list(stop or [])
         tokens = st.engine.tokenizer.encode(prompt_text)
         kv_src = body.get("kv_transfer")
+        # per-request adapter routing: the "model" field selects a
+        # discovered adapter, exactly like the reference serves adapters
+        # as models (inference_api.py:417-498)
+        adapter = ""
+        model_field = body.get("model") or ""
+        if model_field and model_field not in (st.model_name,
+                                               st.engine.md.name):
+            if model_field in getattr(st.engine, "adapter_index", {}):
+                adapter = model_field
+            elif getattr(st.engine, "adapters_merged", False) \
+                    and model_field in st.adapters:
+                adapter = ""      # TP/PP: adapters merged into base weights
+            else:
+                return self._error(404, f"model {model_field!r} not found")
+        if kv_src and adapter:
+            return self._error(400, "per-request adapters are not supported "
+                                    "with KV transfer")
         try:
             if kv_src:
                 req = self._submit_with_transfer(kv_src, params)
@@ -260,7 +277,8 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                 tokens = req.prompt_tokens
             else:
                 req = st.engine.submit(tokens, params,
-                                       req_id=f"cmpl-{uuid.uuid4().hex[:20]}")
+                                       req_id=f"cmpl-{uuid.uuid4().hex[:20]}",
+                                       adapter=adapter)
         except ValueError as e:
             return self._error(400, str(e))
 

@@ -1,11 +1,12 @@
-"""Tune → save adapter → serve with it: the full lifecycle the
-reference covers with PEFT outputs + vLLM LoRA loading."""
-
-import json
+"""Tune → save adapter → serve per-request: the lifecycle the reference
+covers with PEFT outputs + vLLM per-request LoRARequest routing
+(inference_api.py:417-498).  Adapters are selectable models; the base
+path stays untouched."""
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from kaito_tpu.engine.config import EngineConfig
 from kaito_tpu.engine.engine import InferenceEngine, SamplingParams
@@ -16,28 +17,156 @@ from kaito_tpu.tuning.lora import LoraConfig, add_lora_params, save_adapter
 TINY = get_model_by_name("tiny-llama-test").arch
 
 
-def test_engine_serves_merged_adapter(tmp_path):
-    # craft an adapter with a non-zero delta
+def _make_adapter(path, seed, scale=0.5, r=4):
     model = TransformerLM(TINY, dtype=jnp.float32)
     params = add_lora_params(model, model.init_params(jax.random.PRNGKey(0)),
-                             LoraConfig(r=4), jax.random.PRNGKey(1))
-    params["dense"]["q_lora_b"] = 0.5 * jax.random.normal(
-        jax.random.PRNGKey(2), params["dense"]["q_lora_b"].shape, jnp.float32)
-    adir = tmp_path / "adapters" / "style"
-    save_adapter(str(adir), params, LoraConfig(r=4), "tiny-llama-test")
+                             LoraConfig(r=r), jax.random.PRNGKey(seed))
+    params["dense"]["q_lora_b"] = scale * jax.random.normal(
+        jax.random.PRNGKey(seed + 100),
+        params["dense"]["q_lora_b"].shape, jnp.float32)
+    save_adapter(str(path), params, LoraConfig(r=r), "tiny-llama-test")
 
-    cfg = EngineConfig(model="tiny-llama-test", max_model_len=128, page_size=16,
-                       max_num_seqs=2, dtype="float32", kv_dtype="float32",
-                       prefill_buckets=(32,))
-    base_engine = InferenceEngine(cfg)
-    adapted = InferenceEngine(cfg.replace(adapters_dir=str(tmp_path / "adapters")))
 
-    p = SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
-    base_engine.start(); adapted.start()
+@pytest.fixture(scope="module")
+def adapters_dir(tmp_path_factory):
+    root = tmp_path_factory.mktemp("adapters")
+    _make_adapter(root / "style-a", seed=1)
+    _make_adapter(root / "style-b", seed=7, scale=0.8, r=8)
+    return root
+
+
+@pytest.fixture(scope="module")
+def engine(adapters_dir):
+    cfg = EngineConfig(model="tiny-llama-test", max_model_len=128,
+                       page_size=16, max_num_seqs=4, dtype="float32",
+                       kv_dtype="float32", prefill_buckets=(32,),
+                       adapters_dir=str(adapters_dir),
+                       enable_prefix_caching=False)
+    eng = InferenceEngine(cfg)
+    eng.start()
+    yield eng
+    eng.stop()
+
+
+def _greedy(n=6):
+    return SamplingParams(max_tokens=n, temperature=0.0, ignore_eos=True)
+
+
+def test_adapter_selection_changes_output(engine):
+    base = list(engine.submit([5, 6, 7], _greedy()).stream())
+    a = list(engine.submit([5, 6, 7], _greedy(), adapter="style-a").stream())
+    b = list(engine.submit([5, 6, 7], _greedy(), adapter="style-b").stream())
+    # each adapter is a real delta; base path is untouched
+    assert a != base and b != base and a != b
+    base2 = list(engine.submit([5, 6, 7], _greedy()).stream())
+    assert base2 == base
+
+
+def test_concurrent_adapters_isolated(engine):
+    """Different adapters decode in the SAME batch without
+    cross-contamination (the batched-LoRA property)."""
+    solo_a = list(engine.submit([9, 10, 11], _greedy(8),
+                                adapter="style-a").stream())
+    solo_b = list(engine.submit([9, 10, 11], _greedy(8),
+                                adapter="style-b").stream())
+    solo_base = list(engine.submit([9, 10, 11], _greedy(8)).stream())
+    reqs = [engine.submit([9, 10, 11], _greedy(8), adapter="style-a"),
+            engine.submit([9, 10, 11], _greedy(8), adapter="style-b"),
+            engine.submit([9, 10, 11], _greedy(8))]
+    outs = [list(r.stream()) for r in reqs]
+    assert outs[0] == solo_a
+    assert outs[1] == solo_b
+    assert outs[2] == solo_base
+
+
+def test_prefix_cache_isolated_per_adapter(adapters_dir):
+    """Adapter-flavored KV must never be served to base (or other
+    adapter) requests via the shared prefix tree."""
+    from kaito_tpu.native import load_native
+
+    if load_native() is None:
+        pytest.skip("native toolchain unavailable")
+    cfg = EngineConfig(model="tiny-llama-test", max_model_len=128,
+                       page_size=4, max_num_seqs=2, dtype="float32",
+                       kv_dtype="float32", prefill_buckets=(32,),
+                       adapters_dir=str(adapters_dir))
+    eng = InferenceEngine(cfg)
+    assert eng.prefix_cache is not None
+    plain = InferenceEngine(cfg.replace(enable_prefix_caching=False))
+    prompt = [5, 6, 7, 8, 9, 10, 11, 12]   # two full pages, cacheable
+    eng.start(); plain.start()
     try:
-        base_out = list(base_engine.submit([5, 6, 7], p).stream())
-        adapted_out = list(adapted.submit([5, 6, 7], p).stream())
+        ref_a = list(plain.submit(prompt, _greedy(), adapter="style-a").stream())
+        ref_base = list(plain.submit(prompt, _greedy()).stream())
+        # adapter first: its KV must not be committed for the base hit
+        got_a = list(eng.submit(prompt, _greedy(), adapter="style-a").stream())
+        got_base = list(eng.submit(prompt, _greedy()).stream())
+        got_base2 = list(eng.submit(prompt, _greedy()).stream())
+        got_a2 = list(eng.submit(prompt, _greedy(), adapter="style-a").stream())
     finally:
-        base_engine.stop(); adapted.stop()
-    # a real delta must change greedy decoding for synthetic weights
-    assert base_out != adapted_out
+        eng.stop(); plain.stop()
+    assert got_a == ref_a and got_a2 == ref_a
+    assert got_base == ref_base and got_base2 == ref_base
+
+
+def test_unknown_adapter_rejected(engine):
+    with pytest.raises(ValueError, match="unknown adapter"):
+        engine.submit([1, 2, 3], _greedy(), adapter="nope")
+
+
+def test_models_listing_routes(adapters_dir):
+    """/v1/models advertises adapters AND selecting one works over HTTP."""
+    import json
+    import threading
+    import urllib.request
+
+    from kaito_tpu.engine.server import make_server
+
+    cfg = EngineConfig(model="tiny-llama-test", max_model_len=128,
+                       page_size=16, max_num_seqs=2, dtype="float32",
+                       kv_dtype="float32", prefill_buckets=(32,),
+                       adapters_dir=str(adapters_dir),
+                       enable_prefix_caching=False, port=0)
+    eng = InferenceEngine(cfg)
+    eng.start()
+    srv = make_server(eng, cfg, host="127.0.0.1", port=0)
+    port = srv.server_address[1]
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{port}"
+    try:
+        with urllib.request.urlopen(base + "/v1/models", timeout=30) as r:
+            ids = {m["id"] for m in json.loads(r.read())["data"]}
+        assert {"tiny-llama-test", "style-a", "style-b"} <= ids
+
+        def post(body):
+            req = urllib.request.Request(
+                base + "/v1/completions", json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=60) as r:
+                return json.loads(r.read())
+
+        # spy on engine.submit: the model field must route the adapter
+        # (token-level output divergence is pinned by the engine-level
+        # tests; HTTP text can legitimately decode to "" for synthetic
+        # weights, so asserting on text is flaky)
+        routed = []
+        orig_submit = eng.submit
+
+        def spy(tokens, params, **kw):
+            routed.append(kw.get("adapter", ""))
+            return orig_submit(tokens, params, **kw)
+
+        eng.submit = spy
+        body = {"prompt": "hello there", "max_tokens": 6, "temperature": 0}
+        post({**body, "model": "tiny-llama-test"})
+        post({**body, "model": "style-a"})
+        assert routed == ["", "style-a"]
+        # unknown model -> 404, reference contract
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post({**body, "model": "missing-model"})
+        assert ei.value.code == 404
+    finally:
+        srv.shutdown()
+        eng.stop()
