@@ -79,10 +79,13 @@ def load_adapter_stacks(model, adapters_dir: str,
     serve_lora: dict = {}
     for g in model.groups:
         specs = model._layer_specs(g.moe)
-        if g.moe:
-            continue       # expert stacks: adapters target dense layers
+        # MoE groups still have dense ATTENTION projections — their
+        # q/k/v/o adapters apply; only the expert MLP targets are
+        # per-request-unsupported (the moe path has no LoRA sites)
+        targets = (("q", "k", "v", "o") if g.moe
+                   else ("q", "k", "v", "o", "gate", "up", "down"))
         group_buf: dict = {}
-        for t in ("q", "k", "v", "o", "gate", "up", "down"):
+        for t in targets:
             if t not in specs:
                 continue
             in_dim, out_dim = specs[t][0]
@@ -103,6 +106,13 @@ def load_adapter_stacks(model, adapters_dir: str,
             group_buf[f"{t}_b"] = jnp.asarray(B, model.dtype)
         if group_buf:
             serve_lora[g.name] = group_buf
+    if not serve_lora:
+        # no routable targets at all: report nothing loadable so the
+        # caller falls back to merge semantics instead of serving
+        # phantom adapter names
+        logger.warning("adapters in %s carry no per-request-servable "
+                       "targets", adapters_dir)
+        return {}, {}
     name_to_index = {name: i + 1 for i, (name, _, _) in enumerate(loaded)}
     logger.info("loaded %d adapters for per-request serving: %s (rmax=%d)",
                 n, list(name_to_index), rmax)

@@ -28,6 +28,7 @@ class EngineConfig:
     kv_dtype: str = "bfloat16"
     seed: int = 0
     tensor_parallel: int = 1             # TP degree (mesh "tensor" axis)
+    expert_parallel: int = 1             # EP degree (mesh "expert" axis)
     pipeline_parallel: int = 1           # PP stages (mesh "pipeline" axis)
     pp_microbatches: int = 4             # decode microbatches through the ring
     data_parallel: int = 1               # engine replica groups

@@ -158,14 +158,19 @@ class InferenceEngine:
             arch, dtype=self.dtype,
             attn_impl="pallas" if use_pallas else "jax")
         if arch.num_experts > 0:
-            self.model.moe_impl = "ragged"  # grouped-matmul serving path
+            # EP shards the expert reduction via GSPMD over the dense
+            # path (exact, psum-combined); single-group serving keeps
+            # the grouped-matmul (ragged) path whose FLOPs scale with
+            # top_k instead of the expert count
+            self.model.moe_impl = ("dense" if cfg.expert_parallel > 1
+                                   else "ragged")
         self.tokenizer = load_tokenizer(self.md.hf_id, arch.vocab_size)
         self.mesh = mesh if mesh is not None else self._build_mesh()
         self.pp_exec = None
         if cfg.pipeline_parallel > 1:
-            if cfg.tensor_parallel > 1:
+            if cfg.tensor_parallel > 1 or cfg.expert_parallel > 1:
                 raise ValueError("pipeline_parallel composes with "
-                                 "tensor_parallel in a later round")
+                                 "tensor/expert parallelism in a later round")
             if cfg.pd_enabled:
                 raise ValueError("P/D disaggregation is not supported with "
                                  "pipeline-parallel serving")
@@ -202,12 +207,23 @@ class InferenceEngine:
                     self.model, self.params, cfg.adapters_dir)
                 self.adapters_merged = True
             else:
-                from kaito_tpu.engine.adapters import load_adapter_stacks
+                from kaito_tpu.engine.adapters import (
+                    apply_adapters_to_params,
+                    discover_adapters,
+                    load_adapter_stacks,
+                )
 
                 serve_lora, self.adapter_index = load_adapter_stacks(
                     self.model, cfg.adapters_dir, self.md.name)
                 if serve_lora:
                     self.params = {**self.params, "serve_lora": serve_lora}
+                elif discover_adapters(cfg.adapters_dir):
+                    # MLA or no routable targets: keep the round-1
+                    # merge-into-base behavior so advertised adapters
+                    # still take effect (selection routes to base)
+                    self.params = apply_adapters_to_params(
+                        self.model, self.params, cfg.adapters_dir)
+                    self.adapters_merged = True
         if self.pp_exec is not None:
             self.params = self.pp_exec.stage_params(self.params)
         self.prefix_cache = None
@@ -284,19 +300,26 @@ class InferenceEngine:
     # ------------------------------------------------------------------
 
     def _build_mesh(self):
-        """TP mesh from config (the planner's tensor axis): weights and
-        KV heads shard across chips; XLA inserts the collectives."""
+        """TP×EP mesh from config (the planner's tensor/expert axes):
+        weights and KV heads shard across chips, expert stacks place
+        over the expert axis; XLA inserts the collectives."""
         tp = self.cfg.tensor_parallel
-        if tp <= 1:
+        ep = self.cfg.expert_parallel
+        if ep > 1 and (self.md.arch.num_experts < ep
+                       or self.md.arch.num_experts % ep):
+            raise ValueError(f"expert_parallel={ep} must divide the "
+                             f"{self.md.arch.num_experts} experts")
+        if tp * ep <= 1:
             return None
         from kaito_tpu.parallel.mesh import build_mesh
         from kaito_tpu.parallel.plan import make_mesh_spec
 
         devices = jax.devices()
-        if len(devices) < tp:
-            raise ValueError(f"tensor_parallel={tp} but only "
-                             f"{len(devices)} devices visible")
-        return build_mesh(make_mesh_spec(tensor=tp), devices[:tp])
+        if len(devices) < tp * ep:
+            raise ValueError(f"tensor_parallel={tp} x expert_parallel={ep} "
+                             f"but only {len(devices)} devices visible")
+        return build_mesh(make_mesh_spec(expert=ep, tensor=tp),
+                          devices[:tp * ep])
 
     def _build_pp_executor(self):
         """Stage-sharded serving executor over the planner's pipeline

@@ -414,6 +414,8 @@ def main(argv=None):
                     default=int(os.environ.get("KAITO_TENSOR_PARALLEL", "1")))
     ap.add_argument("--pipeline-parallel-size", type=int,
                     default=int(os.environ.get("KAITO_PIPELINE_PARALLEL", "1")))
+    ap.add_argument("--expert-parallel-size", type=int,
+                    default=int(os.environ.get("KAITO_EXPERT_PARALLEL", "1")))
     ap.add_argument("--served-model-name", default="")
     ap.add_argument("--dtype", default="")
     ap.add_argument("--kaito-config-file", default="")
@@ -449,6 +451,7 @@ def main(argv=None):
         max_num_seqs=args.max_num_seqs, served_model_name=args.served_model_name,
         tensor_parallel=args.tensor_parallel_size,
         pipeline_parallel=args.pipeline_parallel_size,
+        expert_parallel=args.expert_parallel_size,
         dtype=args.dtype or ("bfloat16" if on_tpu else "float32"),
         kv_dtype=args.dtype or ("bfloat16" if on_tpu else "float32"),
         adapters_dir=args.kaito_adapters_dir,

@@ -60,7 +60,9 @@ def logical_to_pspec(
 
 # Serving (inference): Megatron-style TP. Weights are sharded on the
 # head/intermediate/vocab dimensions over the tensor axis; activations
-# batch over data.
+# batch over data; expert stacks place over the expert axis (EP — the
+# serving counterpart of the planner's tier-5 expert carve-out), with
+# their intermediate dim still on tensor so TP and EP compose.
 SERVE_RULES = PartitionRules({
     "batch": "data",
     "vocab": "tensor",
@@ -69,7 +71,7 @@ SERVE_RULES = PartitionRules({
     "kv_heads": "tensor",
     "head_dim": None,
     "intermediate": "tensor",
-    "expert": "tensor",
+    "expert": "expert",
     "layers": None,
     "kv_pages": None,
     "seq": None,

