@@ -86,18 +86,46 @@ class Manager:
 
 
 def main(argv=None):
+    import os
+
     ap = argparse.ArgumentParser(prog="kaito-tpu-manager")
     ap.add_argument("--node-provisioner", default="karpenter",
                     choices=["karpenter", "byo"])
     ap.add_argument("--feature-gates", default="")
     ap.add_argument("--base-image-version", default="latest")
-    ap.add_argument("--resync-seconds", type=float, default=2.0)
+    ap.add_argument("--resync-seconds", type=float, default=0.0,
+                    help="0 = auto: 2s in-memory, 30s against a real API "
+                         "server (watch events carry the fast path)")
+    ap.add_argument("--kube-api-url", default="",
+                    help="API server base URL (in-cluster service-account "
+                         "config is used when unset)")
+    ap.add_argument("--in-memory-store", action="store_true",
+                    help="use the in-process store even in-cluster (dev)")
+    ap.add_argument("--namespace",
+                    default=os.environ.get("POD_NAMESPACE", "default"))
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
-    mgr = Manager(node_provisioner=args.node_provisioner,
+
+    store = None
+    in_cluster = "KUBERNETES_SERVICE_HOST" in os.environ
+    if not args.in_memory_store and (args.kube_api_url or in_cluster):
+        from kaito_tpu.k8s import KubeClient, KubeStore
+
+        store = KubeStore(KubeClient(base_url=args.kube_api_url),
+                          namespace=args.namespace)
+        logger.info("using Kubernetes API store (%s)",
+                    args.kube_api_url or "in-cluster")
+    mgr = Manager(store=store, node_provisioner=args.node_provisioner,
                   feature_gates=args.feature_gates,
                   base_image_version=args.base_image_version)
-    mgr.run(args.resync_seconds)
+    if store is not None:
+        # informer analogue: watch streams feed the expectations and
+        # event-driven callbacks registered by the reconcilers
+        from kaito_tpu.k8s.codec import TYPED_KINDS
+
+        store.start_watching(list(TYPED_KINDS))
+    resync = args.resync_seconds or (30.0 if store is not None else 2.0)
+    mgr.run(resync)
 
 
 if __name__ == "__main__":

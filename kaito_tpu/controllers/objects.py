@@ -37,9 +37,13 @@ _API_VERSIONS = {
     "Service": "v1",
     "ConfigMap": "v1",
     "StatefulSet": "apps/v1",
+    "Deployment": "apps/v1",
+    "ControllerRevision": "apps/v1",
     "Job": "batch/v1",
     "NodePool": "karpenter.sh/v1",
+    "NodeClaim": "karpenter.sh/v1",
     "PersistentVolumeClaim": "v1",
+    "InferencePool": "inference.networking.x-k8s.io/v1",
 }
 
 

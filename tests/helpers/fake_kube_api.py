@@ -1,0 +1,246 @@
+"""A miniature kube-apiserver for tests: generic REST storage of RAW
+wire JSON with resourceVersion conflicts, finalizer-gated deletion,
+label selectors, status subresources, and streaming watch — enough
+API-server semantics to prove the controller layer survives the real
+wire format (the envtest analogue SURVEY.md §4 says the reference
+lacks)."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+STATUS_KINDS = ("workspaces", "inferencesets", "ragengines",
+                "multiroleinferences", "modelmirrors")
+
+
+def split_path(path: str):
+    """-> (prefix, plural, namespace|None, name|None, subresource)."""
+    parts = [p for p in path.split("/") if p]
+    sub = ""
+    base = 2 if parts[0] == "api" else 3
+    prefix = "/".join(parts[:base])
+    rest = parts[base:]
+    ns = None
+    if rest and rest[0] == "namespaces":
+        ns, rest = rest[1], rest[2:]
+    plural = rest[0]
+    name = rest[1] if len(rest) > 1 else None
+    if len(rest) > 2 and rest[2] == "status":
+        sub = "status"
+    return prefix, plural, ns, name, sub
+
+
+class FakeKubeAPI:
+    def __init__(self):
+        # (prefix, plural) -> (ns, name) -> raw object dict
+        self.objects: dict[tuple[str, str], dict[tuple[str, str], dict]] = {}
+        self.rv = 0
+        self.uid = 0
+        self.lock = threading.RLock()
+        self._watch_events: list[tuple[tuple[str, str], str, dict]] = []
+        self._watch_cond = threading.Condition(self.lock)
+        self.requests: list[tuple[str, str]] = []
+
+    def raw(self, plural: str, name: str, ns: str = "default"):
+        """Test helper: the stored wire object for a name."""
+        for (prefix, pl), coll in self.objects.items():
+            if pl == plural:
+                obj = coll.get((ns, name)) or coll.get(("", name))
+                if obj is not None:
+                    return obj
+        return None
+
+    def _bump(self, obj: dict) -> None:
+        self.rv += 1
+        obj.setdefault("metadata", {})["resourceVersion"] = str(self.rv)
+
+    def _emit(self, key, evt: str, obj: dict) -> None:
+        self._watch_events.append((key, evt, json.loads(json.dumps(obj))))
+        self._watch_cond.notify_all()
+
+    @staticmethod
+    def _match_labels(obj: dict, selector: str) -> bool:
+        labels = (obj.get("metadata") or {}).get("labels") or {}
+        for part in selector.split(","):
+            if not part:
+                continue
+            k, _, v = part.partition("=")
+            if labels.get(k) != v:
+                return False
+        return True
+
+    def handle(self, method: str, path: str, query: dict, body: dict):
+        with self.lock:
+            recorded = path
+            if query:
+                recorded += "?" + "&".join(
+                    f"{k}={v[0]}" for k, v in sorted(query.items()))
+            self.requests.append((method, recorded))
+            prefix, plural, ns, name, sub = split_path(path)
+            key = (prefix, plural)
+            store = self.objects.setdefault(key, {})
+
+            if method == "POST":
+                obj = body
+                # real apiservers reject bodies whose apiVersion doesn't
+                # match the request path group/version
+                expected = ("v1" if prefix == "api/v1"
+                            else "/".join(prefix.split("/")[1:]))
+                got = obj.get("apiVersion", "")
+                if got != expected:
+                    return 400, {"message": f"apiVersion {got!r} does not "
+                                            f"match endpoint {expected!r}"}
+                nm = obj["metadata"]["name"]
+                ons = obj["metadata"].get("namespace", ns or "")
+                if (ons, nm) in store:
+                    return 409, {"message": f"{nm} already exists"}
+                self.uid += 1
+                obj["metadata"].setdefault("uid", f"uid-{self.uid}")
+                obj["metadata"].setdefault("creationTimestamp",
+                                           "2026-01-01T00:00:00Z")
+                if plural in STATUS_KINDS:
+                    obj.pop("status", None)
+                self._bump(obj)
+                store[(ons, nm)] = obj
+                self._emit(key, "ADDED", obj)
+                return 201, obj
+
+            if method == "GET" and name is None:
+                items = [o for (ons, _), o in store.items()
+                         if ns is None or ons == ns]
+                sel = query.get("labelSelector", [""])[0]
+                if sel:
+                    items = [o for o in items if self._match_labels(o, sel)]
+                return 200, {"kind": "List", "items": items}
+
+            if name is None:
+                return 400, {"message": "collection op unsupported"}
+            okey = (ns or "", name)
+            cur = store.get(okey)
+
+            if method == "GET":
+                if cur is None:
+                    return 404, {"message": f"{name} not found"}
+                return 200, cur
+
+            if method == "PUT":
+                if cur is None:
+                    return 404, {"message": f"{name} not found"}
+                sent_rv = (body.get("metadata") or {}).get(
+                    "resourceVersion", "")
+                if sent_rv and sent_rv != cur["metadata"]["resourceVersion"]:
+                    return 409, {"message": "resourceVersion conflict"}
+                if sub == "status":
+                    cur = dict(cur)
+                    cur["status"] = body.get("status", {})
+                else:
+                    preserved = cur.get("status")
+                    uid = cur["metadata"].get("uid", "")
+                    cur = dict(body)
+                    if plural in STATUS_KINDS and preserved is not None:
+                        cur["status"] = preserved
+                    cur.setdefault("metadata", {})["uid"] = uid
+                self._bump(cur)
+                store[okey] = cur
+                meta = cur.get("metadata", {})
+                if meta.get("deletionTimestamp") and not meta.get("finalizers"):
+                    del store[okey]
+                    self._emit(key, "DELETED", cur)
+                else:
+                    self._emit(key, "MODIFIED", cur)
+                return 200, cur
+
+            if method == "DELETE":
+                if cur is None:
+                    return 404, {"message": f"{name} not found"}
+                meta = cur.setdefault("metadata", {})
+                if meta.get("finalizers"):
+                    if not meta.get("deletionTimestamp"):
+                        meta["deletionTimestamp"] = "2026-01-01T00:00:00Z"
+                        self._bump(cur)
+                        self._emit(key, "MODIFIED", cur)
+                    return 200, cur
+                del store[okey]
+                self._emit(key, "DELETED", cur)
+                return 200, {"status": "Success"}
+
+            return 405, {"message": method}
+
+
+def serve(api: FakeKubeAPI, host: str = "127.0.0.1", port: int = 0):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def _do(self, method):
+            parsed = urlparse(self.path)
+            query = parse_qs(parsed.query)
+            body = {}
+            n = int(self.headers.get("Content-Length", 0) or 0)
+            if n:
+                body = json.loads(self.rfile.read(n))
+            if query.get("watch", ["false"])[0] == "true":
+                return self._watch(parsed.path)
+            status, payload = api.handle(method, parsed.path, query, body)
+            blob = json.dumps(payload).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(blob)))
+            self.end_headers()
+            self.wfile.write(blob)
+
+        def _watch(self, path):
+            prefix, plural, _, _, _ = split_path(path)
+            want = (prefix, plural)
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            with api.lock:
+                idx = len(api._watch_events)
+            deadline = time.monotonic() + 30
+            try:
+                while time.monotonic() < deadline:
+                    with api._watch_cond:
+                        pending = api._watch_events[idx:]
+                        idx = len(api._watch_events)
+                        if not pending:
+                            api._watch_cond.wait(timeout=0.2)
+                    for k, evt, obj in pending:
+                        if k != want:
+                            continue
+                        line = json.dumps(
+                            {"type": evt, "object": obj}).encode() + b"\n"
+                        chunk = f"{len(line):x}\r\n".encode() + line + b"\r\n"
+                        self.wfile.write(chunk)
+                        self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+            try:
+                self.wfile.write(b"0\r\n\r\n")
+            except OSError:
+                pass
+
+        def do_GET(self):
+            self._do("GET")
+
+        def do_POST(self):
+            self._do("POST")
+
+        def do_PUT(self):
+            self._do("PUT")
+
+        def do_DELETE(self):
+            self._do("DELETE")
+
+    srv = ThreadingHTTPServer((host, port), Handler)
+    srv.daemon_threads = True
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv, f"http://{host}:{srv.server_address[1]}"
