@@ -382,10 +382,18 @@ class InferenceEngine:
 
     def _init_params(self):
         if self.cfg.weights_dir:
-            from kaito_tpu.engine.weights import load_safetensors_params
+            wd = self.cfg.weights_dir
+            logger.info("loading checkpoint from %s", wd)
+            if wd.startswith(("gs://", "http://", "https://")):
+                # streaming load: per-tensor ranged reads, no local copy
+                from kaito_tpu.engine.streaming import (
+                    stream_safetensors_params)
 
-            logger.info("loading checkpoint from %s", self.cfg.weights_dir)
-            params = load_safetensors_params(self.model, self.cfg.weights_dir)
+                params = stream_safetensors_params(self.model, wd)
+            else:
+                from kaito_tpu.engine.weights import load_safetensors_params
+
+                params = load_safetensors_params(self.model, wd)
             if self.mesh is not None:
                 params = jax.tree.map(jax.device_put, params,
                                       self._param_shardings())

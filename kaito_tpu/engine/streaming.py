@@ -1,0 +1,237 @@
+"""Streaming weight load: safetensors over ranged reads, no local copy.
+
+The TPU counterpart of the reference's model-streaming subsystem
+(`/root/reference/pkg/workspace/inference/modelstreaming/modelstreaming.go:73`
++ vLLM's runai_streamer load-format): instead of staging a full HF
+snapshot on disk before loading, the engine reads each tensor's exact
+byte span straight from the blob store (GCS JSON-API ranged GETs, auth
+via the GKE metadata server — the workload-identity analogue of the
+reference's ``fetch_sas.py``) and places it directly into the stacked
+device param tree.  A 70B checkpoint therefore needs zero local disk
+and cold-start is bounded by network bandwidth, not copy+load.
+
+The safetensors layout makes this cheap: one small ranged read for the
+JSON header per shard, then one ranged read per tensor.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import struct
+import time
+import urllib.error
+import urllib.request
+from typing import Callable, Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_DTYPES = {
+    "F64": np.float64, "F32": np.float32, "F16": np.float16,
+    "I64": np.int64, "I32": np.int32, "I16": np.int16, "I8": np.int8,
+    "U8": np.uint8, "BOOL": np.bool_,
+}
+
+INDEX_FILE = "model.safetensors.index.json"
+SINGLE_FILE = "model.safetensors"
+
+
+def _bf16():
+    import ml_dtypes
+
+    return ml_dtypes.bfloat16
+
+
+class HTTPRangeReader:
+    """Ranged reads against any HTTP(S) file server.
+
+    One persistent connection per reader (keep-alive) so a
+    thousands-of-tensors load doesn't pay a TLS handshake per read;
+    transient errors (5xx, resets) retry with backoff the way the
+    reference's runai streamer does.
+    """
+
+    def __init__(self, base_url: str,
+                 token_provider: Optional[Callable[[], str]] = None,
+                 retries: int = 4):
+        import http.client
+        import urllib.parse
+
+        self.base_url = base_url.rstrip("/")
+        self.token_provider = token_provider
+        self.retries = retries
+        u = urllib.parse.urlsplit(self.base_url)
+        self._scheme, self._host, self._prefix = u.scheme, u.netloc, u.path
+        self._conn: Optional[http.client.HTTPConnection] = None
+        self.bytes_read = 0
+        self.requests = 0
+
+    def _connect(self):
+        import http.client
+
+        if self._conn is None:
+            cls = (http.client.HTTPSConnection if self._scheme == "https"
+                   else http.client.HTTPConnection)
+            self._conn = cls(self._host, timeout=120)
+        return self._conn
+
+    def _drop(self):
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except OSError:
+                pass
+            self._conn = None
+
+    def read(self, path: str, start: Optional[int] = None,
+             end: Optional[int] = None) -> bytes:
+        """end is EXCLUSIVE; both None reads the whole object."""
+        headers = {}
+        if start is not None:
+            tail = str(end - 1) if end is not None else ""
+            headers["Range"] = f"bytes={start}-{tail}"
+        last: Exception = RuntimeError("no attempts")
+        for attempt in range(self.retries + 1):
+            if self.token_provider:
+                headers["Authorization"] = f"Bearer {self.token_provider()}"
+            try:
+                conn = self._connect()
+                conn.request("GET", f"{self._prefix}/{path}", headers=headers)
+                resp = conn.getresponse()
+                data = resp.read()
+                if resp.status == 404:
+                    raise urllib.error.HTTPError(
+                        f"{self.base_url}/{path}", 404, "not found",
+                        resp.headers, None)
+                if resp.status in (429, 500, 502, 503, 504):
+                    raise OSError(f"HTTP {resp.status} (transient)")
+                if resp.status not in (200, 206):
+                    raise urllib.error.HTTPError(
+                        f"{self.base_url}/{path}", resp.status,
+                        data[:200].decode(errors="replace"),
+                        resp.headers, None)
+                if start is not None and resp.status != 206:
+                    # server ignored Range: refusing protects the
+                    # no-full-shard-fetch contract (and our offsets)
+                    raise RuntimeError(
+                        f"{self._host} ignored Range (HTTP 200 for "
+                        f"{path}); streaming needs a range-capable store")
+                self.bytes_read += len(data)
+                self.requests += 1
+                return data
+            except urllib.error.HTTPError:
+                raise
+            except RuntimeError:
+                raise
+            except Exception as e:   # transient: resets, timeouts, 5xx
+                last = e
+                self._drop()
+                if attempt < self.retries:
+                    time.sleep(min(2.0 ** attempt * 0.2, 5.0))
+        raise last
+
+
+_gcp_token_cache: dict = {"token": "", "expiry": 0.0}
+
+
+def gcp_metadata_token() -> str:
+    """Workload-identity access token from the GKE metadata server (the
+    analogue of the reference's SAS-token init container)."""
+    now = time.monotonic()
+    if _gcp_token_cache["expiry"] - now > 60:
+        return _gcp_token_cache["token"]
+    req = urllib.request.Request(
+        "http://metadata.google.internal/computeMetadata/v1/instance/"
+        "service-accounts/default/token",
+        headers={"Metadata-Flavor": "Google"})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        data = json.loads(resp.read())
+    _gcp_token_cache["token"] = data["access_token"]
+    _gcp_token_cache["expiry"] = now + float(data.get("expires_in", 300))
+    return _gcp_token_cache["token"]
+
+
+def make_reader(location: str) -> HTTPRangeReader:
+    """gs://bucket/prefix -> GCS JSON-API media endpoint; http(s) URLs
+    pass through (tests, plain mirrors)."""
+    if location.startswith("gs://"):
+        bucket, _, prefix = location[len("gs://"):].partition("/")
+        base = f"https://storage.googleapis.com/{bucket}"
+        if prefix:
+            base += f"/{prefix}"
+        return HTTPRangeReader(base, token_provider=gcp_metadata_token)
+    return HTTPRangeReader(location)
+
+
+class SafetensorsStream:
+    """Header-indexed ranged access to one or more safetensors shards."""
+
+    def __init__(self, reader: HTTPRangeReader):
+        self.reader = reader
+        # tensor name -> (file, dtype_str, shape, abs_start, abs_end)
+        self.index: dict[str, tuple[str, str, tuple, int, int]] = {}
+        files = self._discover_files()
+        for f in files:
+            self._index_file(f)
+
+    def _discover_files(self) -> list[str]:
+        try:
+            idx = json.loads(self.reader.read(INDEX_FILE))
+            return sorted(set(idx.get("weight_map", {}).values()))
+        except urllib.error.HTTPError as e:
+            if e.code != 404:
+                raise    # auth/permission problems must surface, not mask
+            return [SINGLE_FILE]
+
+    def _index_file(self, fname: str) -> None:
+        head = self.reader.read(fname, 0, 8)
+        (n,) = struct.unpack("<Q", head)
+        header = json.loads(self.reader.read(fname, 8, 8 + n))
+        data_base = 8 + n
+        for name, meta in header.items():
+            if name == "__metadata__":
+                continue
+            a, b = meta["data_offsets"]
+            self.index[name] = (fname, meta["dtype"], tuple(meta["shape"]),
+                                data_base + a, data_base + b)
+
+    def keys(self) -> list[str]:
+        return sorted(self.index)
+
+    def read_tensor(self, name: str) -> Optional[np.ndarray]:
+        entry = self.index.get(name)
+        if entry is None:
+            return None
+        fname, dtype_s, shape, start, end = entry
+        blob = self.reader.read(fname, start, end)
+        if dtype_s == "BF16":
+            arr = np.frombuffer(blob, dtype=_bf16())
+        else:
+            arr = np.frombuffer(blob, dtype=_DTYPES[dtype_s])
+        return arr.reshape(shape)
+
+
+def stream_safetensors_params(model, location: str,
+                              reader: Optional[HTTPRangeReader] = None
+                              ) -> dict:
+    """Assemble the stacked param tree by streaming each tensor's byte
+    span from the blob store — no staging copy (reference contract:
+    modelstreaming.go SetStreamingConfig + runai_streamer)."""
+    from kaito_tpu.engine.weights import assemble_params
+
+    t0 = time.monotonic()
+    reader = reader or make_reader(location)
+    stream = SafetensorsStream(reader)
+    params = assemble_params(model, stream.read_tensor, stream.keys())
+    secs = time.monotonic() - t0
+    # cold-start record, benchmark-probe style (driver/controller greppable)
+    print("KAITO_WEIGHTS_STREAM_RESULT " + json.dumps({
+        "location": location, "seconds": round(secs, 2),
+        "bytes": reader.bytes_read, "requests": reader.requests,
+        "mib_per_s": round(reader.bytes_read / 2**20 / max(secs, 1e-6), 1),
+    }), flush=True)
+    logger.info("streamed %.1f MiB in %.1fs (%d ranged reads) from %s",
+                reader.bytes_read / 2**20, secs, reader.requests, location)
+    return params

@@ -74,8 +74,18 @@ def _reader(directory: str) -> tuple[Callable[[str], Optional[np.ndarray]], list
 
 def load_safetensors_params(model: TransformerLM, directory: str) -> dict:
     """Assemble the stacked param tree from HF shards on disk."""
-    arch = model.arch
     read, all_keys = _reader(directory)
+    params = assemble_params(model, read, all_keys)
+    logger.info("loaded %d stacked tensors from %s", len(all_keys), directory)
+    return params
+
+
+def assemble_params(model: TransformerLM,
+                    read: Callable[[str], Optional[np.ndarray]],
+                    all_keys: list[str]) -> dict:
+    """Map HF tensors (via any reader — disk shards or ranged streaming)
+    onto the scan-stacked layout."""
+    arch = model.arch
     dtype = model.dtype
 
     def get(name: str, required: bool = True) -> Optional[np.ndarray]:
@@ -157,7 +167,6 @@ def load_safetensors_params(model: TransformerLM, directory: str) -> dict:
                 stack.setdefault(our_key, []).append(np.asarray(tensor))
         params[g.name] = {
             k: jnp.asarray(np.stack(v), dtype) for k, v in stack.items()}
-    logger.info("loaded %d stacked tensors from %s", len(all_keys), directory)
     return params
 
 
