@@ -19,11 +19,12 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
-from kaito_tpu.engine.metrics import Counter, Histogram, Registry
 from kaito_tpu.rag.config import RAGConfig
 from kaito_tpu.rag.embeddings import make_embedder
 from kaito_tpu.rag.guardrails import BLOCK_MESSAGE, OutputGuardrails, StreamingGuard
+from kaito_tpu.rag.lifecycle import LifecycleManager
 from kaito_tpu.rag.llm_client import LLMClient, inject_context
+from kaito_tpu.rag.metrics import RAGMetrics, Timed
 from kaito_tpu.rag.vector_store import VectorIndex
 
 logger = logging.getLogger(__name__)
@@ -33,6 +34,7 @@ class RAGService:
     def __init__(self, cfg: RAGConfig):
         self.cfg = cfg
         self.embedder = make_embedder(cfg)
+        self.embedder = _TimedEmbedder(self.embedder, self)
         self.indexes: dict[str, VectorIndex] = {}
         self.lock = threading.RLock()
         self.llm = LLMClient(cfg.llm_inference_url, cfg.llm_access_secret,
@@ -42,13 +44,35 @@ class RAGService:
                            os.path.exists(cfg.guardrails_policy_file)
                            else OutputGuardrails())
 
-        self.registry = Registry()
-        self.m_requests = Counter("kaito_rag:requests_total", "requests", self.registry,
-                                  labels=("route",))
-        self.m_retrieval = Histogram("kaito_rag:retrieval_seconds",
-                                     "retrieval latency", self.registry)
-        self.m_blocked = Counter("kaito_rag:guardrails_blocked_total",
-                                 "responses blocked", self.registry)
+        self.lifecycle = LifecycleManager()
+        self.metrics = RAGMetrics(self)
+        self.registry = self.metrics.registry
+        # hooks mirroring the reference lifecycle manager: load persisted
+        # indexes on boot, persist on drain (when a persist dir is set)
+        if cfg.persist_dir:
+            self.lifecycle.on_startup(
+                "load-persisted-indexes", self._load_persisted,
+                critical=False)
+            self.lifecycle.on_shutdown("persist-indexes", self._persist_all)
+        self.lifecycle.on_startup("guardrails-policy", self.reload_guardrails,
+                                  critical=False)
+
+    def _load_persisted(self) -> None:
+        base = self.cfg.persist_dir
+        if not os.path.isdir(base):
+            return
+        for name in sorted(os.listdir(base)):
+            d = os.path.join(base, name)
+            if os.path.isdir(d) and os.path.exists(
+                    os.path.join(d, "documents.json")):
+                self.index(name, create=True).load(d)
+                self.metrics.load_ops.inc()
+
+    def _persist_all(self) -> None:
+        with self.lock:
+            for name, idx in self.indexes.items():
+                idx.persist(os.path.join(self.cfg.persist_dir, name))
+                self.metrics.persist_ops.inc()
 
     def _dense_factory(self):
         from kaito_tpu.rag.vector_store import FlatDenseIndex
@@ -85,6 +109,26 @@ class RAGService:
         p = self.cfg.guardrails_policy_file
         if p and os.path.exists(p):
             self.guardrails = OutputGuardrails.from_policy_file(p)
+            self.metrics.guardrail_reloads.inc()
+
+
+class _TimedEmbedder:
+    """Embedder wrapper feeding the embedding-stage metrics."""
+
+    def __init__(self, inner, svc: "RAGService"):
+        self._inner = inner
+        self._svc = svc
+
+    @property
+    def dim(self):
+        return self._inner.dim
+
+    def embed(self, texts):
+        m = self._svc.metrics
+        m.embedding_requests.inc()
+        m.embedding_texts.inc(len(texts))
+        with Timed(m.embedding_seconds):
+            return self._inner.embed(texts)
 
 
 class RAGHandler(BaseHTTPRequestHandler):
@@ -94,7 +138,33 @@ class RAGHandler(BaseHTTPRequestHandler):
     def log_message(self, *a):
         pass
 
+    def _route(self) -> str:
+        p = self.path
+        if p.startswith("/v1/chat"):
+            return "chat"
+        if p == "/retrieve":
+            return "retrieve"
+        if p == "/index" or p.startswith("/indexes"):
+            return "index"
+        if p in ("/persist", "/load"):
+            return "persistence"
+        if p in ("/health", "/metrics"):
+            return "system"
+        return "other"
+
+    def _record(self, code: int):
+        route = self._route()
+        if route == "system":
+            return
+        m = self.svc.metrics
+        m.requests.inc(route=route, status=str(code))
+        if code >= 400:
+            m.errors.inc(route=route)
+        if hasattr(self, "_t0"):
+            m.request_seconds.observe(time.monotonic() - self._t0)
+
     def _json(self, code: int, obj):
+        self._record(code)
         body = json.dumps(obj).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
@@ -116,9 +186,11 @@ class RAGHandler(BaseHTTPRequestHandler):
     # ------------------------------------------------------------------
 
     def do_GET(self):
+        self._t0 = time.monotonic()
         svc = self.svc
         if self.path == "/health":
-            return self._json(200, {"status": "ok"})
+            return self._json(200, {"status": "ok",
+                                    "hooks": svc.lifecycle.report()})
         if self.path == "/metrics":
             body = svc.registry.expose().encode()
             self.send_response(200)
@@ -144,6 +216,7 @@ class RAGHandler(BaseHTTPRequestHandler):
         self._err(404, f"no route {self.path}")
 
     def do_DELETE(self):
+        self._t0 = time.monotonic()
         m = re.match(r"^/indexes/([^/]+)/documents/([^/]+)$", self.path)
         if m:
             try:
@@ -151,6 +224,7 @@ class RAGHandler(BaseHTTPRequestHandler):
             except KeyError as e:
                 return self._err(404, str(e))
             n = idx.delete_documents([m.group(2)])
+            self.svc.metrics.documents_deleted.inc(n)
             return self._json(200, {"deleted": n})
         m = re.match(r"^/indexes/([^/]+)$", self.path)
         if m:
@@ -161,6 +235,7 @@ class RAGHandler(BaseHTTPRequestHandler):
         self._err(404, f"no route {self.path}")
 
     def do_POST(self):
+        self._t0 = time.monotonic()
         svc = self.svc
         if self.path == "/index":
             body = self._body()
@@ -170,12 +245,13 @@ class RAGHandler(BaseHTTPRequestHandler):
             docs = body.get("documents", [])
             if not name or not isinstance(docs, list):
                 return self._err(400, "index_name and documents required")
-            svc.m_requests.inc(route="index")
             texts = [d.get("text", "") if isinstance(d, dict) else str(d)
                      for d in docs]
             metas = [d.get("metadata", {}) if isinstance(d, dict) else {}
                      for d in docs]
-            ids = svc.index(name, create=True).add_documents(texts, metas)
+            with Timed(svc.metrics.indexing_seconds):
+                ids = svc.index(name, create=True).add_documents(texts, metas)
+            svc.metrics.documents_indexed.inc(len(ids))
             return self._json(200, {"index_name": name, "doc_ids": ids})
 
         m = re.match(r"^/indexes/([^/]+)/documents/([^/]+)$", self.path)
@@ -189,6 +265,7 @@ class RAGHandler(BaseHTTPRequestHandler):
                 return self._err(404, str(e))
             new_id = idx.update_document(m.group(2), body.get("text", ""),
                                          body.get("metadata"))
+            svc.metrics.documents_updated.inc()
             return self._json(200, {"doc_id": new_id})
 
         if self.path == "/retrieve":
@@ -203,15 +280,16 @@ class RAGHandler(BaseHTTPRequestHandler):
                 idx = svc.index(name)
             except KeyError as e:
                 return self._err(404, str(e))
-            svc.m_requests.inc(route="retrieve")
-            t0 = time.monotonic()
-            hits = idx.retrieve(
-                query, top_k=int(body.get("top_k", svc.cfg.top_k)),
-                vector_weight=float(body.get("vector_weight",
-                                             svc.cfg.vector_weight)),
-                bm25_weight=float(body.get("bm25_weight", svc.cfg.bm25_weight)),
-                metadata_filter=body.get("metadata_filter"))
-            svc.m_retrieval.observe(time.monotonic() - t0)
+            svc.metrics.retrieval_requests.inc()
+            with Timed(svc.metrics.retrieval_seconds):
+                hits = idx.retrieve(
+                    query, top_k=int(body.get("top_k", svc.cfg.top_k)),
+                    vector_weight=float(body.get("vector_weight",
+                                                 svc.cfg.vector_weight)),
+                    bm25_weight=float(body.get("bm25_weight",
+                                               svc.cfg.bm25_weight)),
+                    metadata_filter=body.get("metadata_filter"))
+            svc.metrics.retrieved_documents.inc(len(hits))
             return self._json(200, {"results": hits})
 
         if self.path == "/persist":
@@ -222,6 +300,7 @@ class RAGHandler(BaseHTTPRequestHandler):
             with svc.lock:
                 for name, idx in svc.indexes.items():
                     idx.persist(os.path.join(base, name))
+                    svc.metrics.persist_ops.inc()
                 names = sorted(svc.indexes)
             return self._json(200, {"persisted": names, "path": base})
 
@@ -239,6 +318,7 @@ class RAGHandler(BaseHTTPRequestHandler):
                         os.path.join(d, "documents.json")):
                     idx = svc.index(name, create=True)
                     idx.load(d)
+                    svc.metrics.load_ops.inc()
                     loaded.append(name)
             return self._json(200, {"loaded": loaded})
 
@@ -258,8 +338,6 @@ class RAGHandler(BaseHTTPRequestHandler):
         messages = body.get("messages")
         if not isinstance(messages, list) or not messages:
             return self._err(400, "'messages' must be a non-empty list")
-        svc.m_requests.inc(route="chat")
-
         index_name = body.pop("index_name", None)
         contexts = []
         if index_name:
@@ -269,15 +347,17 @@ class RAGHandler(BaseHTTPRequestHandler):
                 return self._err(404, str(e))
             query = next((m.get("content", "") for m in reversed(messages)
                           if m.get("role") == "user"), "")
-            t0 = time.monotonic()
-            contexts = idx.retrieve(query, top_k=int(body.pop(
-                "context_top_k", svc.cfg.top_k)))
-            svc.m_retrieval.observe(time.monotonic() - t0)
+            svc.metrics.retrieval_requests.inc()
+            with Timed(svc.metrics.retrieval_seconds):
+                contexts = idx.retrieve(query, top_k=int(body.pop(
+                    "context_top_k", svc.cfg.top_k)))
+            svc.metrics.retrieved_documents.inc(len(contexts))
         payload = dict(body)
         payload["messages"] = inject_context(messages, contexts,
                                              svc.llm.context_window)
 
         if body.get("stream"):
+            self._record(200)
             self.send_response(200)
             self.send_header("Content-Type", "text/event-stream")
             self.send_header("Transfer-Encoding", "chunked")
@@ -288,9 +368,11 @@ class RAGHandler(BaseHTTPRequestHandler):
                                     else json.dumps(obj).encode()) + b"\n\n"
                 self.wfile.write(b"%x\r\n%s\r\n" % (len(data), data))
 
+            svc.metrics.llm_requests.inc(mode="stream")
             guard = StreamingGuard(svc.guardrails)
             blocked = None
             for chunk in svc.llm.chat_stream(payload):
+                svc.metrics.stream_chunks.inc()
                 delta = (chunk.get("choices") or [{}])[0].get("delta", {})
                 text = delta.get("content", "")
                 if not svc.guardrails.enabled:
@@ -311,7 +393,7 @@ class RAGHandler(BaseHTTPRequestHandler):
                     send({"choices": [{"index": 0, "delta": {"content": tail},
                                        "finish_reason": None}]})
             if blocked:
-                svc.m_blocked.inc()
+                svc.metrics.guardrail_blocked.inc()
                 send({"choices": [{"index": 0, "delta": {
                     "content": BLOCK_MESSAGE.format(reason=blocked.reason)},
                     "finish_reason": "content_filter"}]})
@@ -324,22 +406,30 @@ class RAGHandler(BaseHTTPRequestHandler):
 
         import urllib.error
 
+        svc.metrics.llm_requests.inc(mode="sync")
         try:
-            resp = svc.llm.chat(payload)
+            with Timed(svc.metrics.llm_seconds):
+                resp = svc.llm.chat(payload)
         except urllib.error.HTTPError as e:
+            svc.metrics.llm_errors.inc()
+            svc.metrics.errors.inc(route="chat")
             try:
                 detail = json.loads(e.read()).get("error", {}).get("message", "")
             except Exception:
                 detail = str(e)
             return self._err(502, f"upstream inference error ({e.code}): {detail}")
         except urllib.error.URLError as e:
+            svc.metrics.llm_errors.inc()
+            svc.metrics.errors.inc(route="chat")
             return self._err(502, f"upstream inference unreachable: {e.reason}")
         if svc.guardrails.enabled:
             content = (resp.get("choices") or [{}])[0].get(
                 "message", {}).get("content", "")
-            verdict = svc.guardrails.guard(content)
+            svc.metrics.guardrail_scans.inc()
+            with Timed(svc.metrics.guardrail_seconds):
+                verdict = svc.guardrails.guard(content)
             if not verdict.valid:
-                svc.m_blocked.inc()
+                svc.metrics.guardrail_blocked.inc()
                 resp["choices"][0]["message"]["content"] = \
                     BLOCK_MESSAGE.format(reason=verdict.reason)
                 resp["choices"][0]["finish_reason"] = "content_filter"
@@ -368,8 +458,14 @@ def main(argv=None):
     if args.port:
         cfg.port = args.port
     server = make_server(cfg, host=args.host)
+    svc = server.svc  # type: ignore[attr-defined]
+    svc.lifecycle.startup()
+    svc.lifecycle.install_signal_handlers()
     logger.info("RAG service on %s:%d", args.host, cfg.port)
-    server.serve_forever()
+    try:
+        server.serve_forever()
+    finally:
+        svc.lifecycle.shutdown()
 
 
 if __name__ == "__main__":

@@ -155,12 +155,19 @@ class VectorIndex:
                       metadatas: Optional[Sequence[dict]] = None) -> list[str]:
         metadatas = metadatas or [{} for _ in texts]
         vecs = self.embedder.embed(list(texts))
+        hybrid = getattr(self.dense, "supports_hybrid", False)
         ids = []
         with self.lock:
             for text, meta, vec in zip(texts, metadatas, vecs):
                 doc_id = doc_id_for(text)
                 self.docs[doc_id] = Document(doc_id, text, dict(meta))
-                self.dense.add(doc_id, vec)
+                if hybrid:
+                    # backend indexes the sparse form itself (qdrant
+                    # native hybrid); BM25 still feeds persistence-free
+                    # fallback queries
+                    self.dense.add(doc_id, vec, text=text)
+                else:
+                    self.dense.add(doc_id, vec)
                 self.bm25.add(doc_id, text)
                 ids.append(doc_id)
         return ids
@@ -201,10 +208,35 @@ class VectorIndex:
     def retrieve(self, query: str, top_k: int = 5,
                  vector_weight: float = 0.7, bm25_weight: float = 0.3,
                  metadata_filter: Optional[dict] = None) -> list[dict]:
-        """Hybrid weighted fusion of normalized dense + BM25 scores
-        (reference: hybrid_retriever.py 0.7/0.3 weighted mode)."""
+        """Hybrid retrieval: when the dense backend fuses natively
+        (qdrant dense+sparse RRF server-side), its ranking is used
+        as-is; otherwise weighted fusion of normalized dense + BM25
+        scores (reference: hybrid_retriever.py 0.7/0.3 weighted mode)."""
         with self.lock:
             qv = self.embedder.embed([query])[0]
+            # the native path fuses with RRF (no weights) and can't see
+            # our metadata: custom weights or filters take the local
+            # fusion path, which scores the whole corpus
+            native_ok = (getattr(self.dense, "supports_hybrid", False)
+                         and metadata_filter is None
+                         and (vector_weight, bm25_weight) == (0.7, 0.3))
+            if native_ok:
+                ranked = self.dense.hybrid_search(qv, query, top_k * 4)
+                out = []
+                for doc_id, score in ranked:
+                    doc = self.docs.get(doc_id)
+                    if doc is None:
+                        continue
+                    if metadata_filter and any(
+                            doc.metadata.get(k) != v
+                            for k, v in metadata_filter.items()):
+                        continue
+                    out.append({"doc_id": doc_id, "text": doc.text,
+                                "score": round(float(score), 6),
+                                "metadata": doc.metadata})
+                    if len(out) >= top_k:
+                        break
+                return out
             dense = dict(self.dense.search(qv, top_k * 4))
             sparse = self.bm25.scores(query)
             dn, sn = self._minmax(dense), self._minmax(sparse)

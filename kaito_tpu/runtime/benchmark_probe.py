@@ -64,11 +64,12 @@ def wait_healthy(base: str, deadline_s: float) -> bool:
 
 def derive_concurrency(base: str, input_len: int, output_len: int) -> int:
     """Concurrency from live KV capacity (the reference reads vLLM's
-    cache-config gauges; we read kaito:kv_pages_total)."""
+    cache-config gauges; we read kaito:kv_pages_total and the
+    kaito:kv_page_size gauge the engine exports)."""
     m = _get(base + "/metrics")
     pages = _metric(m, "kaito:kv_pages_total")
-    # page size isn't exported; conservative 64-token pages
-    capacity_tokens = pages * 64
+    page_size = _metric(m, "kaito:kv_page_size") or 64
+    capacity_tokens = pages * page_size
     per_seq = input_len + output_len
     return max(1, min(int(capacity_tokens // max(per_seq, 1)) or 1, 64))
 
@@ -89,21 +90,29 @@ def run_benchmark(base: str, *, duration_s: float = BENCHMARK_DURATION_S,
     prompt_text = "benchmark " * max(input_len // 10, 1)
 
     stop = time.monotonic() + duration_s
-    ttfts: list[float] = []
+    ttfts: list[float] = []     # time to FIRST streamed chunk, per request
     errors = [0]
+    lock = threading.Lock()
 
     def worker():
         while time.monotonic() < stop:
             t0 = time.monotonic()
             body = json.dumps({
                 "prompt": prompt_text, "max_tokens": output_len,
-                "temperature": 1.0, "stream": False}).encode()
+                "temperature": 1.0, "stream": True}).encode()
             try:
                 req = urllib.request.Request(
                     base + "/v1/completions", data=body,
                     headers={"Content-Type": "application/json"})
-                urllib.request.urlopen(req, timeout=duration_s + 120).read()
-                ttfts.append(time.monotonic() - t0)
+                with urllib.request.urlopen(req,
+                                            timeout=duration_s + 120) as r:
+                    first = None
+                    for line in r:
+                        if first is None and line.startswith(b"data:"):
+                            first = time.monotonic() - t0
+                    if first is not None:
+                        with lock:
+                            ttfts.append(first)
             except Exception:
                 errors[0] += 1
 
@@ -120,13 +129,19 @@ def run_benchmark(base: str, *, duration_s: float = BENCHMARK_DURATION_S,
     gen1 = _metric(after, "kaito:generation_tokens_total")
     total_tokens = gen1 - gen0
     tpm = total_tokens / max(elapsed, 1e-6) * 60.0
-    ttft_p50 = _metric(after, "kaito:time_to_first_token_seconds_sum") / \
+    # client-observed TTFT from the streamed first chunk (not whole-
+    # request latency); avg from the engine histogram for comparison
+    ttfts.sort()
+    ttft_p50 = ttfts[len(ttfts) // 2] if ttfts else 0.0
+    ttft_avg = _metric(after, "kaito:time_to_first_token_seconds_sum") / \
         max(_metric(after, "kaito:time_to_first_token_seconds_count"), 1)
     result = {
         "vllm_total_tpm": round(tpm, 1),          # key kept for dashboard parity
         "total_tpm": round(tpm, 1),
         "generation_tokens": int(total_tokens),
-        "ttft_avg_ms": round(ttft_p50 * 1000, 1),
+        "ttft_p50_ms": round(ttft_p50 * 1000, 1),
+        "ttft_avg_ms": round(ttft_avg * 1000, 1),
+        "ttft_samples": len(ttfts),
         "elapsed_s": round(elapsed, 1),
         "errors": errors[0],
         "max_concurrency": concurrency,

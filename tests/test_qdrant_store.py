@@ -45,6 +45,25 @@ class FakeQdrant(BaseHTTPRequestHandler):
             return self._json(200, {"result": {"status": "ok"}})
         self._json(404, {})
 
+    @staticmethod
+    def _dense_of(vec):
+        return np.asarray(vec["dense"] if isinstance(vec, dict) else vec)
+
+    @staticmethod
+    def _sparse_score(stored, q):
+        sv = (stored or {}).get("sparse") if isinstance(stored, dict) else None
+        if not sv:
+            return 0.0
+        weights = dict(zip(sv["indices"], sv["values"]))
+        return float(sum(weights.get(i, 0.0) * v
+                         for i, v in zip(q["indices"], q["values"])))
+
+    def _rank(self, col, scores, limit):
+        scored = [{"id": pid, "score": s, "payload": col[pid][1]}
+                  for pid, s in scores.items()]
+        scored.sort(key=lambda r: -r["score"])
+        return scored[:limit]
+
     def do_POST(self):
         parts = self.path.strip("/").split("/")
         col = self.store.get(parts[1], {})
@@ -54,13 +73,33 @@ class FakeQdrant(BaseHTTPRequestHandler):
             return self._json(200, {"result": {}})
         if parts[-1] == "search":
             body = self._body()
-            q = np.asarray(body["vector"])
-            scored = [
-                {"id": pid, "score": float(np.dot(q, np.asarray(vec))),
-                 "payload": payload}
-                for pid, (vec, payload) in col.items()]
-            scored.sort(key=lambda r: -r["score"])
-            return self._json(200, {"result": scored[: body.get("limit", 10)]})
+            qspec = body["vector"]
+            q = np.asarray(qspec["vector"] if isinstance(qspec, dict) else qspec)
+            scores = {pid: float(np.dot(q, self._dense_of(vec)))
+                      for pid, (vec, _) in col.items()}
+            return self._json(200, {
+                "result": self._rank(col, scores, body.get("limit", 10))})
+        if parts[-1] == "query":
+            # Query API: prefetch rankings + server-side RRF fusion
+            body = self._body()
+            rankings = []
+            for pre in body.get("prefetch", []):
+                if pre.get("using") == "sparse":
+                    scores = {pid: self._sparse_score(vec, pre["query"])
+                              for pid, (vec, _) in col.items()}
+                else:
+                    q = np.asarray(pre["query"])
+                    scores = {pid: float(np.dot(q, self._dense_of(vec)))
+                              for pid, (vec, _) in col.items()}
+                ranked = sorted(scores, key=lambda p: -scores[p])
+                rankings.append(ranked[: pre.get("limit", 10)])
+            assert body.get("query", {}).get("fusion") == "rrf"
+            fused: dict = {}
+            for ranked in rankings:
+                for rank, pid in enumerate(ranked):
+                    fused[pid] = fused.get(pid, 0.0) + 1.0 / (60 + rank + 1)
+            return self._json(200, {"result": {"points": self._rank(
+                col, fused, body.get("limit", 10))}})
         self._json(404, {})
 
 
@@ -96,3 +135,31 @@ def test_hybrid_store_with_qdrant_backend(qdrant_url):
                        "the mitochondria is the powerhouse of the cell"])
     hits = idx.retrieve("kv cache pages", top_k=1)
     assert "paged attention" in hits[0]["text"]
+
+
+def test_native_hybrid_fuses_server_side(qdrant_url):
+    """The qdrant backend must use the Query API (prefetch dense+sparse,
+    RRF) — not python-side BM25 fusion (reference qdrant_store.py's
+    native dense+sparse hybrid)."""
+    emb = HashingEmbedder()
+    ix = QdrantDenseIndex(emb.dim, url=qdrant_url)
+    assert ix.supports_hybrid
+    idx = VectorIndex("t", emb, dense_factory=lambda dim: ix)
+    idx.add_documents(["ring attention shards sequences across chips",
+                       "paged attention stores kv cache in pages",
+                       "apples and oranges are fruit"])
+    hits = idx.retrieve("kv cache pages", top_k=2)
+    assert "paged attention" in hits[0]["text"]
+    # sparse-only signal: a term with no dense-hash overlap still ranks
+    # because the server fuses the sparse ranking
+    hits = idx.retrieve("fruit", top_k=1)
+    assert "apples" in hits[0]["text"]
+
+
+def test_sparse_terms_deterministic():
+    from kaito_tpu.rag.qdrant_store import sparse_terms
+
+    i1, v1 = sparse_terms("kv cache pages kv")
+    i2, v2 = sparse_terms("kv cache pages kv")
+    assert i1 == i2 and v1 == v2
+    assert len(i1) == 3 and max(v1) == 2.0   # "kv" tf=2

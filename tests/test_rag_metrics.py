@@ -1,0 +1,93 @@
+"""RAG metric breadth + lifecycle hooks (reference parity:
+prometheus_metrics.py ~30 series, lifecycle/manager.py)."""
+
+import re
+
+import pytest
+
+from kaito_tpu.rag.app import RAGService
+from kaito_tpu.rag.config import RAGConfig
+
+
+def _cfg(**kw):
+    # no embedding model configured -> hashing embedder fallback
+    return RAGConfig(**kw)
+
+
+def _families(expo: str) -> set[str]:
+    return {m.group(1) for m in
+            re.finditer(r"^# TYPE (kaito_rag:[a-z_:]+)", expo, re.M)}
+
+
+def test_metric_family_breadth():
+    svc = RAGService(_cfg())
+    idx = svc.index("docs", create=True)
+    idx.add_documents(["paged attention stores kv in pages",
+                       "ring attention shards sequences"])
+    svc.metrics.requests.inc(route="index", status="200")
+    svc.metrics.retrieval_requests.inc()
+    idx.retrieve("kv pages")
+    fams = _families(svc.registry.expose())
+    assert len(fams) >= 25, sorted(fams)
+    for required in ("kaito_rag:requests_total",
+                     "kaito_rag:embedding_seconds",
+                     "kaito_rag:retrieval_seconds",
+                     "kaito_rag:llm_requests_total",
+                     "kaito_rag:guardrails_blocked_total",
+                     "kaito_rag:documents",
+                     "kaito_rag:uptime_seconds"):
+        assert required in fams
+
+
+def test_embedding_stage_instrumented():
+    svc = RAGService(_cfg())
+    svc.index("d", create=True).add_documents(["one doc", "two doc"])
+    expo = svc.registry.expose()
+    assert "kaito_rag:embedding_texts_total 2" in expo
+    assert "kaito_rag:embedding_requests_total 1" in expo
+
+
+def test_lifecycle_persist_load_roundtrip(tmp_path):
+    cfg = _cfg(persist_dir=str(tmp_path))
+    svc = RAGService(cfg)
+    svc.lifecycle.startup()          # nothing persisted yet: no-op
+    svc.index("notes", create=True).add_documents(["kv pages doc"])
+    svc.lifecycle.shutdown()         # persists indexes
+    assert (tmp_path / "notes" / "documents.json").exists()
+
+    svc2 = RAGService(cfg)
+    svc2.lifecycle.startup()         # loads persisted indexes
+    assert "notes" in svc2.indexes
+    hits = svc2.index("notes").retrieve("kv pages", top_k=1)
+    assert hits and "kv pages" in hits[0]["text"]
+    report = svc2.lifecycle.report()
+    assert any(h["name"] == "load-persisted-indexes" and h["ran"]
+               for h in report)
+
+
+def test_lifecycle_critical_startup_failure_aborts():
+    from kaito_tpu.rag.lifecycle import LifecycleManager
+
+    lm = LifecycleManager()
+    lm.on_startup("boom", lambda: (_ for _ in ()).throw(RuntimeError("x")))
+    with pytest.raises(RuntimeError):
+        lm.startup()
+    lm2 = LifecycleManager()
+    lm2.on_startup("soft", lambda: (_ for _ in ()).throw(RuntimeError("x")),
+                   critical=False)
+    lm2.startup()                    # non-critical failures don't abort
+    assert lm2.report()[0]["error"]
+
+
+def test_shutdown_hooks_all_run_despite_failures():
+    from kaito_tpu.rag.lifecycle import LifecycleManager
+
+    ran = []
+    lm = LifecycleManager()
+    lm.on_shutdown("a", lambda: ran.append("a"))
+    lm.on_shutdown("b", lambda: (_ for _ in ()).throw(RuntimeError("x")))
+    lm.on_shutdown("c", lambda: ran.append("c"))
+    lm.shutdown()
+    assert ran == ["a", "c"]
+    lm.shutdown()                    # idempotent
+    assert ran == ["a", "c"]
