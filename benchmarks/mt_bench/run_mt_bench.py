@@ -72,14 +72,18 @@ def run(model_url: str, judge_url: str, questions: list[dict],
             answers.append(answer)
         ratings = []
         for turn, answer in zip(q["turns"], answers):
-            judge_out = _chat(judge_url, [{
-                "role": "user",
-                "content": JUDGE_PROMPT.format(question=turn, answer=answer),
-            }], max_tokens=256, temperature=0.0)
+            # one failed judge call must not lose the whole run's scores
             try:
+                judge_out = _chat(judge_url, [{
+                    "role": "user",
+                    "content": JUDGE_PROMPT.format(question=turn,
+                                                   answer=answer),
+                }], max_tokens=256, temperature=0.0)
                 start = judge_out.find("{")
                 rating = float(json.loads(judge_out[start:]).get("rating", 0))
-            except (ValueError, json.JSONDecodeError):
+            except Exception as e:
+                print(f"judge failed for q{q['question_id']}: {e}",
+                      file=sys.stderr)
                 rating = 0.0
             ratings.append(rating)
         score = statistics.mean(ratings) if ratings else 0.0
@@ -95,6 +99,57 @@ def run(model_url: str, judge_url: str, questions: list[dict],
     return summary
 
 
+# the reference's published-table columns
+# (presets/workspace/models/model_catalog_mtbench_scores.md)
+TABLE_CATEGORIES = ("writing", "roleplay", "reasoning", "math", "coding",
+                    "extraction", "stem", "humanities")
+TABLE_HEADER = ("| Model | Overall | " +
+                " | ".join(c.title() for c in TABLE_CATEGORIES) + " |")
+
+
+def _table_row(model_name: str, summary: dict) -> str:
+    cats = summary.get("categories", {})
+    cells = [f"{cats[c]:.2f}" if c in cats else "-"
+             for c in TABLE_CATEGORIES]
+    return f"| {model_name} | {summary['overall']:.2f} | " + \
+        " | ".join(cells) + " |"
+
+
+def update_score_table(path: str, model_name: str, summary: dict) -> None:
+    """Append/update this model's row in the markdown score catalog —
+    the artifact the reference publishes
+    (model_catalog_mtbench_scores.md); rows keep overall-descending
+    order."""
+    import os
+
+    rows: dict[str, str] = {}
+    if os.path.exists(path):
+        for line in open(path):
+            line = line.rstrip()
+            if line.startswith("|") and not line.startswith(("| Model",
+                                                             "|---")):
+                name = line.split("|")[1].strip()
+                rows[name] = line
+    rows[model_name] = _table_row(model_name, summary)
+
+    def overall(line: str) -> float:
+        try:
+            return float(line.split("|")[2])
+        except (IndexError, ValueError):
+            return 0.0
+
+    ordered = sorted(rows.values(), key=overall, reverse=True)
+    sep = "|" + "---|" * (len(TABLE_CATEGORIES) + 2)
+    # atomic replace: the catalog accumulates across many runs and must
+    # survive a crash mid-write (or two jobs racing)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write("# MT-Bench scores (kaito-tpu engine)\n\n")
+        f.write(TABLE_HEADER + "\n" + sep + "\n")
+        f.write("\n".join(ordered) + "\n")
+    os.replace(tmp, path)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--model-url", required=True,
@@ -104,6 +159,11 @@ def main(argv=None) -> int:
     ap.add_argument("--questions", default="",
                     help="jsonl question file (default: built-in slice)")
     ap.add_argument("--max-tokens", type=int, default=512)
+    ap.add_argument("--model-name", default="",
+                    help="row name for the score table artifact")
+    ap.add_argument("--output-table", default="",
+                    help="markdown score catalog to append/update "
+                         "(the published-table artifact)")
     args = ap.parse_args(argv)
     questions = BUILTIN_QUESTIONS
     if args.questions:
@@ -111,6 +171,9 @@ def main(argv=None) -> int:
             questions = [json.loads(l) for l in f if l.strip()]
     summary = run(args.model_url, args.judge_url, questions, args.max_tokens)
     print(json.dumps(summary, indent=2))
+    if args.output_table:
+        update_score_table(args.output_table,
+                           args.model_name or args.model_url, summary)
     return 0
 
 

@@ -44,21 +44,41 @@ def build_mesh(spec: MeshSpec, devices: Optional[Sequence] = None) -> Mesh:
 def fit_mesh_spec(spec: MeshSpec, num_devices: int) -> MeshSpec:
     """Clamp a planned mesh to an available device count, preserving the
     tensor axis first (tests and dry-runs run on fewer virtual devices
-    than the plan's slice)."""
+    than the plan's slice).  Axes shrink along their DIVISORS (a 6-wide
+    axis steps 6→3→1, not 6→3→1-via-floor-halving with silent
+    remainders), and any degradation is logged."""
+    import logging
+
     sizes = dict(spec.axes)
     total = math.prod(sizes.values())
     if total == num_devices:
         return spec
     # Shrink axes outermost-first until the product fits.
+    from kaito_tpu.parallel.plan import _largest_divisor_leq
+
     order = [n for n, _ in spec.axes]
     for name in order:
         while total > num_devices and sizes[name] > 1:
-            sizes[name] //= 2
-            total = math.prod(sizes.values())
+            s = sizes[name]
+            # the largest divisor of s that brings the product within
+            # the device budget in ONE step (never skipping a divisor
+            # that fits exactly, e.g. fsdp=12 onto 4 devices -> 4)
+            cap = max(1, s * num_devices // total)
+            d = _largest_divisor_leq(s, cap) if cap < s else s
+            if d == s:
+                d = _largest_divisor_leq(s, s - 1)
+            sizes[name] = d
+            total = total // s * d
     # Grow data axis if devices remain.
     if total < num_devices and num_devices % total == 0:
         sizes["data"] = sizes.get("data", 1) * (num_devices // total)
-    return MeshSpec(axes=tuple((n, sizes[n]) for n, _ in spec.axes))
+        total = num_devices
+    fitted = MeshSpec(axes=tuple((n, sizes[n]) for n, _ in spec.axes))
+    if fitted.axes != spec.axes:
+        logging.getLogger(__name__).warning(
+            "mesh %s does not fit %d devices; degraded to %s",
+            spec, num_devices, fitted)
+    return fitted
 
 
 def initialize_distributed(

@@ -1,0 +1,47 @@
+"""MT-bench published-table artifact + mesh-fit divisor behavior."""
+
+import sys
+import os
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "benchmarks", "mt_bench"))
+
+from run_mt_bench import update_score_table  # noqa: E402
+
+
+def test_score_table_appends_and_orders(tmp_path):
+    path = str(tmp_path / "scores.md")
+    update_score_table(path, "phi-4-mini-instruct", {
+        "overall": 7.48, "categories": {"writing": 8.0, "math": 6.5,
+                                        "coding": 7.1}})
+    update_score_table(path, "llama-3.3-70b-instruct", {
+        "overall": 7.34, "categories": {"writing": 8.2, "reasoning": 6.9}})
+    update_score_table(path, "deepseek-v3-0324", {
+        "overall": 8.07, "categories": {"math": 8.5}})
+    text = open(path).read()
+    assert "| Model | Overall | Writing |" in text
+    rows = [l for l in text.splitlines() if l.startswith("|")
+            and "Model" not in l and "---" not in l]
+    assert [r.split("|")[1].strip() for r in rows] == [
+        "deepseek-v3-0324", "phi-4-mini-instruct", "llama-3.3-70b-instruct"]
+    # re-running a model updates its row in place
+    update_score_table(path, "phi-4-mini-instruct", {
+        "overall": 7.60, "categories": {"writing": 8.1}})
+    rows = [l for l in open(path).read().splitlines()
+            if "phi-4-mini" in l]
+    assert len(rows) == 1 and "7.60" in rows[0]
+
+
+def test_fit_mesh_spec_divisor_shrink():
+    from kaito_tpu.parallel.mesh import fit_mesh_spec
+    from kaito_tpu.parallel.plan import make_mesh_spec
+
+    # 6-wide fsdp axis onto 4 devices: shrink along divisors (6 -> 3
+    # -> ... never a silent floor-halving remainder)
+    spec = make_mesh_spec(fsdp=6, tensor=2)
+    fitted = fit_mesh_spec(spec, 4)
+    assert fitted.num_devices == 4
+    assert fitted.size("tensor") == 2
+    # perfect fit is untouched
+    spec2 = make_mesh_spec(data=2, tensor=4)
+    assert fit_mesh_spec(spec2, 8) is spec2
