@@ -1,0 +1,133 @@
+"""Tool-call and reasoning-content output parsers.
+
+The reference's presets carry per-model tool/reasoning parser configs
+that vLLM applies server-side (`presets/workspace/generator/generator.go`
+emits ``--tool-call-parser``/``--reasoning-parser`` flags); this module
+is the engine-side counterpart: it turns raw generated text into the
+OpenAI response shape — ``tool_calls`` entries for models prompted with
+tools, and ``reasoning_content`` split out of think-tagged output
+(DeepSeek-R1 style).
+
+Formats covered (the two the reference's catalog uses most):
+- hermes:  ``<tool_call>{"name": ..., "arguments": {...}}</tool_call>``
+- mistral: ``[TOOL_CALLS][{"name": ..., "arguments": {...}}, ...]``
+- reasoning: ``<think> ... </think>`` prefix
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import uuid
+from dataclasses import dataclass, field
+from typing import Optional
+
+_THINK_RE = re.compile(r"^\s*<think>(.*?)</think>\s*", re.S)
+_HERMES_RE = re.compile(r"<tool_call>\s*(\{.*?\})\s*</tool_call>", re.S)
+_MISTRAL_TAG = "[TOOL_CALLS]"
+
+
+@dataclass
+class ParsedMessage:
+    content: str = ""
+    reasoning_content: Optional[str] = None
+    tool_calls: list[dict] = field(default_factory=list)
+
+    @property
+    def finish_reason(self) -> Optional[str]:
+        return "tool_calls" if self.tool_calls else None
+
+
+def split_reasoning(text: str) -> tuple[Optional[str], str]:
+    """DeepSeek-R1 style: leading <think>...</think> becomes
+    reasoning_content; an unterminated think block (generation cut off
+    mid-thought) is all reasoning."""
+    m = _THINK_RE.match(text)
+    if m:
+        return m.group(1).strip(), text[m.end():]
+    stripped = text.lstrip()
+    if stripped.startswith("<think>"):
+        return stripped[len("<think>"):].strip(), ""
+    return None, text
+
+
+def _tool_call_entry(obj: dict) -> Optional[dict]:
+    name = obj.get("name")
+    if not name:
+        return None
+    args = obj.get("arguments", obj.get("parameters", {}))
+    if not isinstance(args, str):
+        args = json.dumps(args)
+    return {"id": f"call_{uuid.uuid4().hex[:24]}",
+            "type": "function",
+            "function": {"name": name, "arguments": args}}
+
+
+def parse_hermes_tool_calls(text: str) -> tuple[list[dict], str]:
+    calls = []
+    for m in _HERMES_RE.finditer(text):
+        try:
+            entry = _tool_call_entry(json.loads(m.group(1)))
+        except json.JSONDecodeError:
+            continue
+        if entry:
+            calls.append(entry)
+    if calls:
+        text = _HERMES_RE.sub("", text).strip()
+    return calls, text
+
+
+def parse_mistral_tool_calls(text: str) -> tuple[list[dict], str]:
+    i = text.find(_MISTRAL_TAG)
+    if i < 0:
+        return [], text
+    payload = text[i + len(_MISTRAL_TAG):].strip()
+    try:
+        decoded = json.JSONDecoder().raw_decode(payload)
+    except json.JSONDecodeError:
+        return [], text
+    objs, end = decoded
+    if isinstance(objs, dict):
+        objs = [objs]
+    if not isinstance(objs, list):
+        return [], text          # scalar after the tag: not a tool call
+    calls = [e for e in (_tool_call_entry(o) for o in objs
+                         if isinstance(o, dict)) if e]
+    if not calls:
+        return [], text
+    rest = (text[:i] + payload[end:]).strip()
+    return calls, rest
+
+
+def parse_message(text: str, reasoning: bool = True,
+                  tools: bool = True) -> ParsedMessage:
+    """Full output post-processing: reasoning split, then tool-call
+    extraction (hermes first, mistral fallback)."""
+    reasoning_content = None
+    if reasoning:
+        reasoning_content, text = split_reasoning(text)
+    calls: list[dict] = []
+    if tools:
+        calls, text = parse_hermes_tool_calls(text)
+        if not calls:
+            calls, text = parse_mistral_tool_calls(text)
+    return ParsedMessage(content=text, reasoning_content=reasoning_content,
+                         tool_calls=calls)
+
+
+def render_tools_prompt(tools: list[dict]) -> str:
+    """System-message block describing available tools and the expected
+    call format (hermes-style, the format parse_message reads back)."""
+    specs = []
+    for t in tools or []:
+        fn = t.get("function", t)
+        specs.append({"name": fn.get("name", ""),
+                      "description": fn.get("description", ""),
+                      "parameters": fn.get("parameters", {})})
+    return (
+        "You have access to the following tools:\n"
+        + json.dumps(specs, indent=2)
+        + "\n\nTo call a tool, reply with exactly:\n"
+        + '<tool_call>{"name": "<tool-name>", "arguments": {...}}'
+        + "</tool_call>"
+    )

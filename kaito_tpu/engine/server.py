@@ -228,6 +228,32 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                 messages = body.get("messages")
                 if not isinstance(messages, list) or not messages:
                     return self._error(400, "'messages' must be a non-empty list")
+                tools = body.get("tools")
+                if tools:
+                    if not isinstance(tools, list) or not all(
+                            isinstance(t, dict) for t in tools):
+                        return self._error(
+                            400, "'tools' must be a list of tool objects")
+                    if body.get("stream"):
+                        return self._error(
+                            400, "tool calls are not supported with "
+                                 "streaming yet")
+                    # advertise tools hermes-style; parse_message reads
+                    # the call format back out of the generation. Merge
+                    # into an existing system message so chat templates
+                    # that keep only one system block see both.
+                    from kaito_tpu.engine.parsers import render_tools_prompt
+
+                    messages = list(messages)
+                    tp = render_tools_prompt(tools)
+                    if messages and messages[0].get("role") == "system":
+                        messages[0] = {
+                            "role": "system",
+                            "content": (messages[0].get("content", "")
+                                        + "\n\n" + tp)}
+                    else:
+                        messages = [{"role": "system", "content": tp}] \
+                            + messages
                 prompt_text = render_chat(st.engine.tokenizer, messages,
                                           model_id=st.engine.md.name)
             else:
@@ -351,8 +377,22 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                  "completion_tokens": len(out_ids),
                  "total_tokens": len(tokens) + len(out_ids)}
         if chat:
-            choice = {"index": 0, "message": {"role": "assistant", "content": text},
-                      "finish_reason": finish}
+            # tool-call + reasoning post-processing, gated per-preset
+            # exactly like the reference's parser flags (generator.go)
+            from kaito_tpu.engine.parsers import parse_message
+
+            parsed = parse_message(
+                text,
+                reasoning=bool(getattr(st.engine.md, "reasoning_parser",
+                                       None)),
+                tools=bool(body.get("tools")))
+            message = {"role": "assistant", "content": parsed.content}
+            if parsed.reasoning_content is not None:
+                message["reasoning_content"] = parsed.reasoning_content
+            if parsed.tool_calls:
+                message["tool_calls"] = parsed.tool_calls
+            choice = {"index": 0, "message": message,
+                      "finish_reason": parsed.finish_reason or finish}
         else:
             choice = {"index": 0, "text": text, "logprobs": None,
                       "finish_reason": finish}

@@ -1,0 +1,111 @@
+"""Tool-call + reasoning output parsers (the per-preset parser configs
+the reference emits as vLLM flags, generator.go)."""
+
+import json
+
+from kaito_tpu.engine.parsers import (
+    parse_hermes_tool_calls,
+    parse_message,
+    parse_mistral_tool_calls,
+    render_tools_prompt,
+    split_reasoning,
+)
+
+
+def test_reasoning_split():
+    r, c = split_reasoning("<think>step 1\nstep 2</think>The answer is 4.")
+    assert r == "step 1\nstep 2"
+    assert c == "The answer is 4."
+    r, c = split_reasoning("plain answer")
+    assert r is None and c == "plain answer"
+    # cut off mid-thought: everything is reasoning
+    r, c = split_reasoning("<think>still going")
+    assert r == "still going" and c == ""
+
+
+def test_hermes_tool_calls():
+    text = ('Sure.\n<tool_call>{"name": "get_weather", '
+            '"arguments": {"city": "Paris"}}</tool_call>')
+    calls, rest = parse_hermes_tool_calls(text)
+    assert len(calls) == 1
+    fn = calls[0]["function"]
+    assert fn["name"] == "get_weather"
+    assert json.loads(fn["arguments"]) == {"city": "Paris"}
+    assert calls[0]["id"].startswith("call_")
+    assert rest == "Sure."
+    # malformed JSON is skipped without crashing
+    calls, rest = parse_hermes_tool_calls("<tool_call>{oops</tool_call>hm")
+    assert calls == [] and "hm" in rest
+
+
+def test_mistral_tool_calls():
+    text = ('[TOOL_CALLS][{"name": "search", "arguments": '
+            '{"q": "tpu"}}, {"name": "open", "arguments": {"id": 3}}]')
+    calls, rest = parse_mistral_tool_calls(text)
+    assert [c["function"]["name"] for c in calls] == ["search", "open"]
+    assert rest == ""
+    calls, rest = parse_mistral_tool_calls("no tools here")
+    assert calls == [] and rest == "no tools here"
+
+
+def test_parse_message_combined():
+    text = ('<think>need the weather</think>'
+            '<tool_call>{"name": "get_weather", "arguments": {}}</tool_call>')
+    msg = parse_message(text)
+    assert msg.reasoning_content == "need the weather"
+    assert msg.tool_calls[0]["function"]["name"] == "get_weather"
+    assert msg.finish_reason == "tool_calls"
+    assert msg.content == ""
+
+
+def test_tools_prompt_round_trips_format():
+    prompt = render_tools_prompt([{"type": "function", "function": {
+        "name": "get_weather", "description": "d",
+        "parameters": {"type": "object"}}}])
+    assert "get_weather" in prompt and "<tool_call>" in prompt
+
+
+def test_server_chat_emits_tool_calls(monkeypatch):
+    """The chat route returns OpenAI tool_calls when the model emits the
+    hermes format (generation stubbed — synthetic weights can't call
+    tools on purpose)."""
+    import threading
+    import urllib.request
+
+    import jax
+
+    from kaito_tpu.engine.config import EngineConfig
+    from kaito_tpu.engine.engine import InferenceEngine
+    from kaito_tpu.engine.server import make_server
+
+    cfg = EngineConfig(model="tiny-llama-test", max_model_len=2048,
+                       page_size=16, max_num_seqs=2, dtype="float32",
+                       kv_dtype="float32", prefill_buckets=(256, 1024),
+                       enable_prefix_caching=False, port=0)
+    eng = InferenceEngine(cfg)
+    canned = ('<tool_call>{"name": "get_weather", '
+              '"arguments": {"city": "Paris"}}</tool_call>')
+    monkeypatch.setattr(
+        eng.tokenizer, "decode",
+        lambda ids, _orig=eng.tokenizer.decode: canned)
+    eng.start()
+    srv = make_server(eng, cfg, host="127.0.0.1", port=0)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.server_address[1]}/v1/chat/completions",
+            json.dumps({
+                "messages": [{"role": "user", "content": "weather?"}],
+                "tools": [{"type": "function", "function":
+                           {"name": "get_weather", "parameters": {}}}],
+                "max_tokens": 4, "temperature": 0}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            out = json.loads(r.read())
+    finally:
+        srv.shutdown()
+        eng.stop()
+    choice = out["choices"][0]
+    assert choice["finish_reason"] == "tool_calls"
+    assert choice["message"]["tool_calls"][0]["function"]["name"] == \
+        "get_weather"
