@@ -51,6 +51,9 @@ def cluster():
                 "TPU_WORKER_ID": str(pid),
                 "TPU_WORKER_HOSTNAMES": "127.0.0.1,127.0.0.1",
                 "KAITO_COORDINATOR": f"127.0.0.1:{coord}",
+                # `python script.py` puts the script dir, not cwd, on
+                # sys.path — the helper must still import kaito_tpu.
+                "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
             })
             procs.append(subprocess.Popen(
                 [sys.executable, HELPER] + args, env=env, cwd=REPO,
