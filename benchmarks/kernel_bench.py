@@ -1,0 +1,113 @@
+"""Micro-benchmarks for the engine's Pallas kernels vs their JAX paths.
+
+Run on a real TPU (or CPU with --interpret) to get per-kernel parity and
+throughput numbers.  All test data is generated ON DEVICE with
+jax.random — the axon tunnel's host->device path is slow, so numpy
+staging would dominate wall time.
+
+Usage:  python benchmarks/kernel_bench.py [--decode] [--prefill] [--iters N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def _timeit(fn, *args, iters: int = 50) -> float:
+    fn(*args).block_until_ready()          # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    out.block_until_ready()
+    return (time.perf_counter() - t0) / iters
+
+
+def bench_decode(iters: int) -> None:
+    from kaito_tpu.engine.attention import paged_decode_attention
+    from kaito_tpu.engine.ops.decode_attention import (
+        paged_decode_attention_pallas)
+
+    B, H, Hkv, D, ps = 32, 24, 8, 128, 64
+    P, pmax = 2048, 32
+    scale = D ** -0.5
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv, kt, kl = jax.random.split(key, 5)
+    q = jax.random.normal(kq, (B, H, D), jnp.bfloat16)
+    ck = jax.random.normal(kk, (P, Hkv, ps, D), jnp.bfloat16)
+    cv = jax.random.normal(kv, (P, Hkv, ps, D), jnp.bfloat16)
+    pt = jax.random.randint(kt, (B, pmax), 0, P, jnp.int32)
+    lens = jax.random.randint(kl, (B,), 64, pmax * ps, jnp.int32)
+    win = jnp.asarray(1 << 30, jnp.int32)
+
+    o_p = paged_decode_attention_pallas(q, ck, cv, pt, lens, win, scale=scale)
+    o_j = paged_decode_attention(q, ck, cv, pt, lens, scale=scale)
+    err = float(jnp.max(jnp.abs(o_p.astype(jnp.float32)
+                                - o_j.astype(jnp.float32))))
+    print(f"decode parity: max abs err = {err:.4f}")
+
+    # caches must be ARGUMENTS, not closure captures: captured device
+    # arrays become compile-time constants and a 268 MiB constant takes
+    # minutes to ship through the axon tunnel's compile path.
+    f = jax.jit(lambda q, ck, cv, pt, lens: paged_decode_attention_pallas(
+        q, ck, cv, pt, lens, win, scale=scale))
+    g = jax.jit(lambda q, ck, cv, pt, lens: paged_decode_attention(
+        q, ck, cv, pt, lens, scale=scale))
+    live_bytes = float(jnp.sum(lens)) * Hkv * D * 2 * 2   # K+V, bf16
+    for name, fn in (("pallas", f), ("jax", g)):
+        dt = _timeit(fn, q, ck, cv, pt, lens, iters=iters)
+        print(f"decode[{name}]: {dt * 1e6:8.1f} us/call, "
+              f"effective live-KV bw {live_bytes / dt / 1e9:6.1f} GB/s")
+
+
+def bench_prefill(iters: int) -> None:
+    from kaito_tpu.engine.attention import prefill_attention
+    from kaito_tpu.engine.ops.flash_prefill import flash_prefill_attention
+
+    B, T, H, Hkv, D = 4, 1024, 24, 8, 128
+    scale = D ** -0.5
+    key = jax.random.PRNGKey(1)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, T, H, D), jnp.bfloat16)
+    k = jax.random.normal(kk, (B, T, Hkv, D), jnp.bfloat16)
+    v = jax.random.normal(kv, (B, T, Hkv, D), jnp.bfloat16)
+    tl = jnp.asarray([T, T * 3 // 4, 127, 1], jnp.int32)
+    win = jnp.asarray(1 << 30, jnp.int32)
+
+    o_p = flash_prefill_attention(q, k, v, tl, win, scale=scale)
+    o_j = prefill_attention(q, k, v, scale=scale, true_len=tl)
+    mask = jnp.arange(T)[None, :, None, None] < tl[:, None, None, None]
+    err = float(jnp.max(jnp.abs(
+        (o_p.astype(jnp.float32) - o_j.astype(jnp.float32)) * mask)))
+    print(f"prefill parity: max abs err = {err:.4f}")
+
+    f = jax.jit(lambda q, k, v: flash_prefill_attention(
+        q, k, v, tl, win, scale=scale))   # tl/win are small, safe to capture
+    g = jax.jit(lambda q, k, v: prefill_attention(
+        q, k, v, scale=scale, true_len=tl))
+    causal_flops = 4 * B * H * D * T * T / 2
+    for name, fn in (("pallas", f), ("jax", g)):
+        dt = _timeit(fn, q, k, v, iters=iters)
+        print(f"prefill[{name}]: {dt * 1e3:8.2f} ms/call, "
+              f"{causal_flops / dt / 1e12:5.1f} TFLOP/s (causal)")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--decode", action="store_true")
+    ap.add_argument("--prefill", action="store_true")
+    ap.add_argument("--iters", type=int, default=50)
+    args = ap.parse_args()
+    run_all = not (args.decode or args.prefill)
+    print(f"backend: {jax.default_backend()}, device: {jax.devices()[0]}")
+    if args.decode or run_all:
+        bench_decode(args.iters)
+    if args.prefill or run_all:
+        bench_prefill(args.iters)
+
+
+if __name__ == "__main__":
+    main()

@@ -33,6 +33,11 @@ class EngineConfig:
     pp_microbatches: int = 4             # decode microbatches through the ring
     data_parallel: int = 1               # engine replica groups
     use_pallas: Optional[bool] = None    # None = auto (TPU yes, CPU no)
+    # fused decode steps per dispatch when the batch is in steady-state
+    # decode (no prefills staged, queue empty): one lax.scan dispatch
+    # runs K steps with on-device sampling + stop detection, amortizing
+    # the per-step host round-trip.  None = auto (8 on TPU, 1 elsewhere)
+    decode_run_ahead: Optional[int] = None
     # serving-side knobs carried over from the reference wrapper surface
     port: int = 5000
     served_model_name: str = ""

@@ -47,6 +47,10 @@ from kaito_tpu.models.registry import get_model_by_name
 
 logger = logging.getLogger(__name__)
 
+# columns in the fused-decode on-device stop matrix; requests with more
+# stop ids than this fall back to the single-step path
+_STOP_WIDTH = 8
+
 
 @dataclass
 class SamplingParams:
@@ -290,6 +294,11 @@ class InferenceEngine:
         self._decode_fn = self._build_decode_fn()
         self._prefill_fns: dict[int, object] = {}
         self._sample_one = jax.jit(sample)
+        ra = cfg.decode_run_ahead
+        if ra is None:
+            ra = 8 if jax.default_backend() == "tpu" else 1
+        self.run_ahead = max(1, int(ra))
+        self._decode_multi_fns: dict[int, object] = {}
 
         from kaito_tpu.engine.pd import KVExportRegistry
 
@@ -458,6 +467,41 @@ class InferenceEngine:
             return cache, sampling, next_tokens
 
         return decode_step
+
+    def _build_decode_multi_fn(self, K: int):
+        """K fused decode steps in ONE dispatch (lax.scan) with
+        on-device sampling, stop-token detection and per-slot budget
+        tracking.  A slot that emits a stop token (or exhausts its
+        budget) goes inactive inside the scan, so no KV is ever written
+        past its last real token — the host replays the returned
+        (tokens, active) trace through the exact same _emit path as the
+        single-step loop."""
+        model = self.model
+
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def decode_multi(params, cache, sampling, tokens, positions,
+                         page_tables, active, adapter_ids, stop_ids,
+                         steps_left):
+            def body(carry, _):
+                cache, sampling, toks, pos, act, left = carry
+                cache, logits = model.decode(params, cache, toks, pos,
+                                             page_tables, act,
+                                             adapter_ids=adapter_ids)
+                nxt, sampling = sample(logits, sampling)
+                nxt = jnp.where(act, nxt, toks)
+                left = left - act.astype(jnp.int32)
+                # stop_ids is -1-padded, token ids are >= 0
+                hit = jnp.any(nxt[:, None] == stop_ids, axis=1)
+                act_next = act & ~hit & (left > 0)
+                pos = pos + act.astype(jnp.int32)
+                return (cache, sampling, nxt, pos, act_next, left), (nxt, act)
+
+            carry = (cache, sampling, tokens, positions, active, steps_left)
+            (cache, sampling, *_), (toks, acts) = jax.lax.scan(
+                body, carry, None, length=K)
+            return cache, sampling, toks, acts
+
+        return decode_multi
 
     def _prefill_fn(self, bucket: int):
         fn = self._prefill_fns.get(bucket)
@@ -724,12 +768,22 @@ class InferenceEngine:
         # ensure BEFORE admitting: growth of running sequences must not
         # be starved by a fresh admission grabbing the last pages (which
         # would be preempted right back — wasted churn)
+        la = 1
         if self.active.any():
-            self._ensure_decode_pages()
+            la = self._decode_lookahead()
+            self._ensure_decode_pages(la)
         did = self._admit_new()
         decoding = bool(self.active.any())
         if decoding:
-            self._decode_once()
+            # recheck the gate: ensure-pages may have preempted (queue
+            # non-empty now), and ANY admission — including KV-import /
+            # spill-restore slots that begin decoding immediately —
+            # post-dates the page-reservation pass, so its slots have no
+            # lookahead pages yet
+            if la > 1 and not did and self._decode_lookahead() == la:
+                self._decode_multi(la)
+            else:
+                self._decode_once()
             did = True
         self._tick += 1
         if (not decoding) or self.cfg.prefill_interleave <= 1 \
@@ -1064,16 +1118,16 @@ class InferenceEngine:
             return None
         return max(candidates, key=lambda i: self.slots[i].seq)
 
-    def _ensure_decode_pages(self):
+    def _ensure_decode_pages(self, lookahead: int = 1):
         """Reserve-on-demand: before a decode step, every active slot
-        must own the page its next KV write lands in; when the pool is
-        dry, the newest-admitted sequence yields (requeue + recompute
-        later) — even if it is the one that needs the page."""
-        ps = self.cfg.page_size
+        must own the page its next KV write lands in (the next
+        ``lookahead`` writes, for a fused multi-step dispatch); when the
+        pool is dry, the newest-admitted sequence yields (requeue +
+        recompute later) — even if it is the one that needs the page."""
         for i, slot in enumerate(self.slots):
             if not self.active[i] or slot.request is None:
                 continue
-            needed = slot.position // ps + 1
+            needed = self._pages_needed(slot, lookahead)
             while len(slot.pages) < needed:
                 page = self._alloc_one_page()
                 if page is not None:
@@ -1108,6 +1162,103 @@ class InferenceEngine:
             self._emit(i, int(toks[i]))
             self.last_tokens[i] = int(toks[i])
 
+    def _decode_lookahead(self) -> int:
+        """How many decode steps the next dispatch may fuse.  >1 only in
+        steady-state decode: nothing waiting, nothing prefilling, every
+        active slot's stop set fits the fixed device matrix, and no
+        abort is pending (aborts are host-side knowledge; the 1-step
+        path retires them promptly).  K is clamped to the batch's max
+        remaining budget (power-of-two bucketed, so at most
+        log2(run_ahead) compiled programs) and to what the free page
+        pool covers — speculative lookahead pages must never preempt a
+        running sequence."""
+        K = self.run_ahead
+        if K <= 1 or self.pp_exec is not None or self._waiting_count:
+            return 1
+        max_rem = 0
+        for i, s in enumerate(self.slots):
+            if s.request is None:
+                continue
+            if s.prefilling or s.request.aborted:
+                return 1
+            if self.active[i]:
+                if len(self._stop_set(s.request)) > _STOP_WIDTH:
+                    return 1
+                max_rem = max(max_rem, s.remaining)
+        if max_rem < K:
+            # every slot finishes within the window: shrink the scan so
+            # it doesn't burn full-batch steps past the last real token
+            K = 1 << max(0, max_rem.bit_length() - 1)
+        if K > 1 and not self._lookahead_fits(K):
+            return 1
+        return max(1, K)
+
+    def _pages_needed(self, slot: "_Slot", lookahead: int) -> int:
+        """Pages a decoding slot must own for its next ``lookahead``
+        KV writes: they cover positions [position, position+steps-1],
+        where a slot whose budget ends earlier goes inactive in-scan
+        and never writes past position + remaining - 1."""
+        steps = max(1, min(lookahead, slot.remaining))
+        return (slot.position + steps - 1) // self.cfg.page_size + 1
+
+    def _lookahead_fits(self, K: int) -> bool:
+        """True when every active slot's next-K page growth comes out
+        of the free pool — i.e. _ensure_decode_pages(K) will not have
+        to preempt anybody for speculative pages."""
+        extra = 0
+        for i, slot in enumerate(self.slots):
+            if not self.active[i] or slot.request is None:
+                continue
+            extra += max(0, self._pages_needed(slot, K) - len(slot.pages))
+        return extra <= self.allocator.available
+
+    def _decode_multi(self, K: int):
+        """One fused K-step decode dispatch; replay the emitted-token
+        trace through the single-step _emit path (stop handling,
+        eviction, streaming) on the host."""
+        fn = self._decode_multi_fns.get(K)
+        if fn is None:
+            fn = self._decode_multi_fns[K] = self._build_decode_multi_fn(K)
+        S = len(self.slots)
+        stop = np.full((S, _STOP_WIDTH), -1, np.int32)
+        left = np.zeros((S,), np.int32)
+        for i, slot in enumerate(self.slots):
+            if slot.request is None or not self.active[i]:
+                continue
+            ids = sorted(self._stop_set(slot.request))
+            stop[i, :len(ids)] = ids
+            left[i] = slot.remaining
+        cache, sampling, toks, acts = fn(
+            self.params, self.cache, self.sampling,
+            jnp.asarray(self.last_tokens),
+            jnp.asarray(self.positions),
+            jnp.asarray(self.page_tables),
+            jnp.asarray(self.active),
+            jnp.asarray(self.slot_adapters),
+            jnp.asarray(stop),
+            jnp.asarray(left))
+        self.cache = cache
+        self.sampling = sampling
+        self.counters["decode_steps_total"] += K
+        toks = np.asarray(toks)       # [K, S]
+        acts = np.asarray(acts)       # [K, S] — device active BEFORE step k
+        for k in range(K):
+            for i, slot in enumerate(self.slots):
+                # slot.request goes None when _emit retires it mid-trace
+                if not acts[k, i] or slot.request is None:
+                    continue
+                self.positions[i] += 1
+                slot.position += 1
+                self._emit(i, int(toks[k, i]))
+                self.last_tokens[i] = int(toks[k, i])
+
+    def _stop_set(self, req: Request) -> set:
+        stop_ids = set(req.params.stop_token_ids)
+        eos = self.tokenizer.eos_token_id
+        if eos is not None and not req.params.ignore_eos:
+            stop_ids.add(eos)
+        return stop_ids
+
     def _emit(self, slot_idx: int, token: int):
         """Deliver one generated token; retire the slot when finished."""
         slot = self.slots[slot_idx]
@@ -1117,10 +1268,7 @@ class InferenceEngine:
         slot.remaining -= 1
         self.counters["generation_tokens_total"] += 1
 
-        eos = self.tokenizer.eos_token_id
-        stop_ids = set(req.params.stop_token_ids)
-        if eos is not None and not req.params.ignore_eos:
-            stop_ids.add(eos)
+        stop_ids = self._stop_set(req)
         finished = token in stop_ids or slot.remaining <= 0 or req.aborted
         if token not in stop_ids:
             req.out.put(token)

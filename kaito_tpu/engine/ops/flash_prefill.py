@@ -29,10 +29,10 @@ DEFAULT_BLOCK_K = 128
 def _flash_kernel(
     true_len_ref,      # [B] SMEM (scalar prefetch)
     window_ref,        # [1] SMEM
-    q_ref,             # [1, Bq, 1, D] VMEM (pre-scaled)
-    k_ref,             # [1, T, 1, D] VMEM
-    v_ref,             # [1, T, 1, D] VMEM
-    o_ref,             # [1, Bq, 1, D] VMEM
+    q_ref,             # [1, 1, Bq, D] VMEM (pre-scaled)
+    k_ref,             # [1, 1, T, D] VMEM
+    v_ref,             # [1, 1, T, D] VMEM
+    o_ref,             # [1, 1, Bq, D] VMEM
     *,
     block_k: int,
     softcap: Optional[float],
@@ -42,9 +42,9 @@ def _flash_kernel(
     true_len = true_len_ref[b]
     window = window_ref[0]
 
-    q = q_ref[0, :, 0, :]                    # [Bq, D]
+    q = q_ref[0, 0]                          # [Bq, D]
     Bq, D = q.shape
-    T = k_ref.shape[1]
+    T = k_ref.shape[2]
     q_start = qi * Bq
     num_k_blocks = pl.cdiv(jnp.minimum(q_start + Bq, true_len), block_k)
 
@@ -52,8 +52,8 @@ def _flash_kernel(
 
     def body(ki, carry):
         m, l, acc = carry
-        k = k_ref[0, pl.ds(ki * block_k, block_k), 0, :]   # [Bk, D]
-        v = v_ref[0, pl.ds(ki * block_k, block_k), 0, :]
+        k = k_ref[0, 0, pl.ds(ki * block_k, block_k), :]   # [Bk, D]
+        v = v_ref[0, 0, pl.ds(ki * block_k, block_k), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)  # [Bq, Bk]
         if softcap:
@@ -77,7 +77,7 @@ def _flash_kernel(
     l0 = jnp.zeros((Bq, 1), jnp.float32)
     acc0 = jnp.zeros((Bq, D), jnp.float32)
     m, l, acc = jax.lax.fori_loop(0, num_k_blocks, body, (m0, l0, acc0))
-    o_ref[0, :, 0, :] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 
 @functools.partial(
@@ -106,22 +106,30 @@ def flash_prefill_attention(
                          f"block sizes ({bq}, {bk})")
     grid = (B, H, T // bq)
 
+    # Head-major [B, H, T, D] layout so every block's trailing two dims
+    # are (seq, head_dim) — real-TPU lowering requires the last two
+    # block dims be (8, 128)-tileable or span the full array dim.
+    qt = (q * scale).astype(q.dtype).transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, bq, 1, D), lambda b, h, t, *_: (b, t, h, 0)),
-            pl.BlockSpec((1, T, 1, D), lambda b, h, t, *_: (b, 0, h // G, 0)),
-            pl.BlockSpec((1, T, 1, D), lambda b, h, t, *_: (b, 0, h // G, 0)),
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, t, *_: (b, h, t, 0)),
+            pl.BlockSpec((1, 1, T, D), lambda b, h, t, *_: (b, h // G, 0, 0)),
+            pl.BlockSpec((1, 1, T, D), lambda b, h, t, *_: (b, h // G, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, 1, D), lambda b, h, t, *_: (b, t, h, 0)),
+        out_specs=pl.BlockSpec((1, 1, bq, D), lambda b, h, t, *_: (b, h, t, 0)),
     )
     kernel = functools.partial(_flash_kernel, block_k=bk, softcap=softcap)
-    return pl.pallas_call(
+    out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, T, H, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(true_len, jnp.reshape(window, (1,)), (q * scale).astype(q.dtype), k, v)
+    )(true_len, jnp.reshape(window, (1,)), qt, kt, vt)
+    return out.transpose(0, 2, 1, 3)

@@ -1,0 +1,262 @@
+"""Fused multi-step decode ("run-ahead") must be observationally
+identical to the single-step loop: same tokens, same stop behavior,
+same page accounting — it only changes how many decode steps ride one
+device dispatch.
+
+Reference contrast: vLLM's multi-step scheduling (the reference serves
+via vLLM flags, presets/workspace/inference/vllm/inference_api.py);
+here the fused path is a lax.scan with on-device sampling and stop
+detection, the TPU-native shape of the same idea.
+"""
+
+import time
+
+import pytest
+
+from kaito_tpu.engine.config import EngineConfig
+from kaito_tpu.engine.engine import InferenceEngine, SamplingParams
+
+
+def _make_engine(run_ahead):
+    cfg = EngineConfig(
+        model="tiny-llama-test",
+        max_model_len=256,
+        page_size=16,
+        max_num_seqs=4,
+        dtype="float32",
+        kv_dtype="float32",
+        prefill_buckets=(32, 64, 128),
+        decode_run_ahead=run_ahead,
+    )
+    eng = InferenceEngine(cfg)
+    eng.start()
+    return eng
+
+
+@pytest.fixture(scope="module")
+def engines():
+    single = _make_engine(1)
+    fused = _make_engine(4)
+    yield single, fused
+    single.stop()
+    fused.stop()
+
+
+def test_greedy_parity(engines):
+    single, fused = engines
+    p = SamplingParams(max_tokens=24, temperature=0.0, ignore_eos=True)
+    prompts = [[1, 2, 3, 4, 5], [7, 8, 9], [11], list(range(20, 45))]
+    outs_single = [list(single.submit(pr, p).stream()) for pr in prompts]
+    outs_fused = [list(fused.submit(pr, p).stream()) for pr in prompts]
+    assert outs_single == outs_fused
+    for o in outs_fused:
+        assert len(o) == 24
+
+
+def test_stop_token_inside_fused_window(engines):
+    """A stop token hitting mid-window must end the stream at exactly
+    the same token as the single-step path, and the slot must free."""
+    single, fused = engines
+    p0 = SamplingParams(max_tokens=32, temperature=0.0, ignore_eos=True)
+    ref = list(single.submit([3, 1, 4, 1, 5], p0).stream())
+    # pick a token the greedy continuation actually emits mid-sequence
+    stop_tok = ref[7]
+    first_hit = ref.index(stop_tok)
+    p_stop = SamplingParams(max_tokens=32, temperature=0.0,
+                            ignore_eos=True, stop_token_ids=(stop_tok,))
+    out_s = list(single.submit([3, 1, 4, 1, 5], p_stop).stream())
+    out_f = list(fused.submit([3, 1, 4, 1, 5], p_stop).stream())
+    assert out_s == out_f == ref[:first_hit]
+    # engine goes idle again: stream-end is signalled just before the
+    # slot is evicted, so poll briefly
+    deadline = time.monotonic() + 5
+    while fused.num_running and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert fused.num_running == 0
+
+
+def test_max_tokens_mid_window(engines):
+    """max_tokens not divisible by the fused K: budget must end the
+    sequence exactly, not at a K boundary."""
+    single, fused = engines
+    for n in (1, 2, 5, 7):
+        p = SamplingParams(max_tokens=n, temperature=0.0, ignore_eos=True)
+        s = list(single.submit([2, 4, 6], p).stream())
+        f = list(fused.submit([2, 4, 6], p).stream())
+        assert s == f and len(f) == n
+
+
+def test_fused_page_growth_across_boundary(engines):
+    """Positions crossing page boundaries inside one fused window must
+    land KV in freshly reserved pages (parity implies correct reads)."""
+    single, fused = engines
+    # prompt of 14 on page_size 16: decode crosses into page 2 at step 2
+    prompt = list(range(1, 15))
+    p = SamplingParams(max_tokens=40, temperature=0.0, ignore_eos=True)
+    s = list(single.submit(prompt, p).stream())
+    f = list(fused.submit(prompt, p).stream())
+    assert s == f and len(f) == 40
+
+
+def test_fused_with_sampled_path(engines):
+    """Stochastic sampling: same seed => same stream, fused or not
+    (sampling state advances once per decode step in both paths)."""
+    single, fused = engines
+    p = SamplingParams(max_tokens=16, temperature=0.8, top_k=40, seed=1234,
+                       ignore_eos=True)
+    s = list(single.submit([5, 10, 15], p).stream())
+    f = list(fused.submit([5, 10, 15], p).stream())
+    assert s == f
+
+
+def test_lookahead_clamps_to_remaining_budget():
+    """Short-budget batches must not burn full-K dead steps: with every
+    request at max_tokens=2 and run_ahead=8, the scan shrinks to the
+    budget instead of dispatching 8 steps of which 6 are dead."""
+    eng = _make_engine(8)
+    try:
+        p = SamplingParams(max_tokens=2, temperature=0.0, ignore_eos=True)
+        reqs = [eng.submit([40 + i, 50 + i], p) for i in range(4)]
+        for r in reqs:
+            assert len(list(r.stream())) == 2
+        # 4 prompts decode 2 tokens each (first comes from prefill);
+        # unclamped run-ahead would log 8+ steps per dispatch
+        assert eng.counters["decode_steps_total"] <= 8
+    finally:
+        eng.stop()
+
+
+def test_speculative_pages_never_preempt():
+    """When the free pool cannot cover K-step growth, the engine must
+    fall back to single-step decode instead of preempting a running
+    sequence for pages it then doesn't use."""
+    cfg = EngineConfig(
+        model="tiny-llama-test",
+        max_model_len=128,
+        page_size=4,           # tiny pages: growth is constant
+        max_num_seqs=2,
+        max_pages=17,          # 16 usable = exactly 2 slots x 8 pages
+        dtype="float32",
+        kv_dtype="float32",
+        prefill_buckets=(16, 32),
+        decode_run_ahead=8,
+        enable_prefix_caching=False,
+        host_kv_offload_bytes=0,
+    )
+    eng = InferenceEngine(cfg)
+    eng.start()
+    try:
+        # two 13-token prompts + 18 decodes each = 31 tokens = 8 pages
+        # per slot: fits exactly single-step, but 8-step lookahead would
+        # overshoot the pool near the end and try to preempt
+        p = SamplingParams(max_tokens=18, temperature=0.0, ignore_eos=True)
+        reqs = [eng.submit(list(range(1 + i, 14 + i)), p) for i in range(2)]
+        for r in reqs:
+            assert len(list(r.stream())) == 18
+        assert eng.counters["preemptions_total"] == 0
+    finally:
+        eng.stop()
+
+
+def test_import_admission_mid_window_decodes_correctly():
+    """A KV-import admission activates its slot immediately (no prefill
+    stage), AFTER the iteration's lookahead page-reservation pass — the
+    fused dispatch must not run that iteration, or the imported slot's
+    lookahead KV writes would land in the unreserved null page.  Driven
+    step-by-step (no loop thread) so the race is deterministic."""
+    def mk(run_ahead):
+        cfg = EngineConfig(
+            model="tiny-llama-test", max_model_len=256, page_size=16,
+            max_num_seqs=2, dtype="float32", kv_dtype="float32",
+            prefill_buckets=(32, 64), seed=0, pd_enabled=True,
+            decode_run_ahead=run_ahead, enable_prefix_caching=False)
+        return InferenceEngine(cfg)
+
+    # reference greedy continuation from a plain single-step engine
+    prompt = list(range(1, 16))   # 15 tokens: prompt+first fills page 1
+    p = SamplingParams(max_tokens=20, temperature=0.0, ignore_eos=True)
+    ref = mk(1)
+    ref.start()
+    ref_out = list(ref.submit(prompt, p).stream())
+    ref.stop()
+
+    # producer engine stages the export (its own scheduling is irrelevant)
+    prod = mk(1)
+    prod.start()
+    pre = prod.submit(prompt, SamplingParams(max_tokens=1, temperature=0.0,
+                                             ignore_eos=True),
+                      export_kv=True)
+    first = list(pre.stream())[0]
+    export = prod.kv_exports.pop(pre.req_id)
+    prod.stop()
+
+    # consumer: drive manually; get a long-running request into steady
+    # fused decode, then inject the import admission
+    eng = mk(8)
+    keeper = eng.submit([3, 5, 7], SamplingParams(
+        max_tokens=120, temperature=0.0, ignore_eos=True))
+    for _ in range(40):
+        eng.step()
+        if eng.active.any() and not any(
+                s.prefilling for s in eng.slots if s.request):
+            break
+    assert eng.active.any()
+    # the true race: the import lands BETWEEN the iteration's lookahead
+    # page-reservation pass and its admission pass (client threads
+    # submit concurrently with the scheduler loop).  Inject it there.
+    state = {}
+    orig_admit = eng._admit_new
+
+    def race_admit():
+        state["imp"] = eng.submit_with_kv(prompt, first, export.meta,
+                                          export.payload, p)
+        eng._admit_new = orig_admit    # one-shot
+        return orig_admit()
+
+    eng._admit_new = race_admit
+    before = eng.counters["decode_steps_total"]
+    eng.step()
+    imp = state["imp"]
+    # the iteration that admits the import MUST take the single-step
+    # path: the imported slot joined after the lookahead reservation
+    # pass, so a fused window would write its KV into the null page
+    # (invisible in token output here — the tiny synthetic model is
+    # degenerate — hence this structural assertion)
+    assert eng.counters["decode_steps_total"] - before == 1
+    for _ in range(400):
+        eng.step()
+        if imp.finish_reason:
+            break
+    assert imp.output_tokens == ref_out
+    for _ in range(400):
+        if keeper.finish_reason:
+            break
+        eng.step()
+
+
+def test_fused_under_page_pressure_falls_back_and_completes():
+    """A pool too small for everyone: the engine must preempt, fall
+    back to single-step when the queue is non-empty, and still finish
+    every request with the right token count."""
+    cfg = EngineConfig(
+        model="tiny-llama-test",
+        max_model_len=128,
+        page_size=16,
+        max_num_seqs=4,
+        max_pages=14,          # 13 usable pages for 4 slots
+        dtype="float32",
+        kv_dtype="float32",
+        prefill_buckets=(32, 64),
+        decode_run_ahead=4,
+        enable_prefix_caching=False,
+    )
+    eng = InferenceEngine(cfg)
+    eng.start()
+    try:
+        p = SamplingParams(max_tokens=30, temperature=0.0, ignore_eos=True)
+        reqs = [eng.submit([10 + i, 20 + i, 30 + i], p) for i in range(4)]
+        outs = [list(r.stream()) for r in reqs]
+        for o in outs:
+            assert len(o) == 30
+    finally:
+        eng.stop()
