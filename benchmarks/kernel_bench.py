@@ -1,7 +1,6 @@
 """Micro-benchmarks for the engine's Pallas kernels vs their JAX paths.
 
-Run on a real TPU (or CPU with --interpret) to get per-kernel parity and
-throughput numbers.  All test data is generated ON DEVICE with
+Run on a real TPU to get per-kernel parity and throughput numbers.  All test data is generated ON DEVICE with
 jax.random — the axon tunnel's host->device path is slow, so numpy
 staging would dominate wall time.
 
@@ -37,8 +36,8 @@ def bench_decode(iters: int) -> None:
     key = jax.random.PRNGKey(0)
     kq, kk, kv, kt, kl = jax.random.split(key, 5)
     q = jax.random.normal(kq, (B, H, D), jnp.bfloat16)
-    ck = jax.random.normal(kk, (P, Hkv, ps, D), jnp.bfloat16)
-    cv = jax.random.normal(kv, (P, Hkv, ps, D), jnp.bfloat16)
+    ck = jax.random.normal(kk, (P, ps, Hkv, D), jnp.bfloat16)
+    cv = jax.random.normal(kv, (P, ps, Hkv, D), jnp.bfloat16)
     pt = jax.random.randint(kt, (B, pmax), 0, P, jnp.int32)
     lens = jax.random.randint(kl, (B,), 64, pmax * ps, jnp.int32)
     win = jnp.asarray(1 << 30, jnp.int32)

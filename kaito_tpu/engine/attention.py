@@ -23,6 +23,21 @@ def _gqa_expand(x: jax.Array, groups: int) -> jax.Array:
     return jnp.repeat(x, groups, axis=-2)
 
 
+def _layer_view(cache: jax.Array, layer):
+    """Resolve the optional stacked-group form of a paged cache.
+
+    Returns ``(flat_cache [Lg*P, ...], page_base)`` where a page index p
+    of the selected layer lives at row ``page_base + p``.  With
+    ``layer=None`` the cache is a single layer ``[P, ...]`` (the round-1
+    contract kept for tests/benchmarks); with ``layer`` given it is the
+    stacked group ``[Lg, P, ...]`` and the flatten-plus-offset gather
+    avoids materializing a 30+ MiB per-layer slice inside the scan."""
+    if layer is None:
+        return cache, 0
+    Lg, P = cache.shape[:2]
+    return cache.reshape(Lg * P, *cache.shape[2:]), layer * P
+
+
 def prefill_attention(
     q: jax.Array,            # [B, T, H, D]
     k: jax.Array,            # [B, T, Hkv, D]
@@ -64,7 +79,7 @@ def prefill_attention(
 
 def paged_context_attention(
     q: jax.Array,            # [B, T, H, D] chunk queries
-    cache_k: jax.Array,      # [P, Hkv, ps, D] (chunk KV already written)
+    cache_k: jax.Array,      # [P, ps, Hkv, D] (chunk KV already written)
     cache_v: jax.Array,
     page_tables: jax.Array,  # [B, pmax]
     start_pos: jax.Array,    # [B] absolute position of q[:, 0]
@@ -73,21 +88,24 @@ def paged_context_attention(
     scale: float,
     sliding_window: Optional[jax.Array] = None,
     logit_softcap: Optional[float] = None,
+    layer: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Chunked prefill WITH prior context: queries attend over the whole
     paged history (cached prefix + the freshly-written chunk) with
     absolute-position causal masking.  Backs prefix-cache reuse and
     long-prompt chunked prefill."""
     B, T, H, D = q.shape
-    _, Hkv, ps, _ = cache_k.shape
+    ps, Hkv, _ = cache_k.shape[-3:]
     pmax = page_tables.shape[1]
     S = pmax * ps
     groups = H // Hkv
 
-    k = cache_k[page_tables]                      # [B, pmax, Hkv, ps, D]
-    v = cache_v[page_tables]
-    k = jnp.moveaxis(k, 2, 3).reshape(B, S, Hkv, D)
-    v = jnp.moveaxis(v, 2, 3).reshape(B, S, Hkv, D)
+    cache_k, base = _layer_view(cache_k, layer)
+    cache_v, _ = _layer_view(cache_v, layer)
+    k = cache_k[base + page_tables]               # [B, pmax, ps, Hkv, D]
+    v = cache_v[base + page_tables]
+    k = k.reshape(B, S, Hkv, D)
+    v = v.reshape(B, S, Hkv, D)
     k = _gqa_expand(k, groups)
     v = _gqa_expand(v, groups)
 
@@ -145,7 +163,7 @@ def mla_prefill_attention(
 def mla_paged_context_attention(
     q_nope: jax.Array,        # [B, T, H, dn] chunk queries
     q_rope: jax.Array,        # [B, T, H, dr] (roped)
-    cache_latent: jax.Array,  # [P, 1, ps, dl+dr] (chunk latent already written)
+    cache_latent: jax.Array,  # [P, ps, 1, dl+dr] (chunk latent already written)
     page_tables: jax.Array,   # [B, pmax]
     start_pos: jax.Array,     # [B] absolute position of q[:, 0]
     true_lens: jax.Array,     # [B] valid NEW tokens in the chunk
@@ -154,6 +172,7 @@ def mla_paged_context_attention(
     *,
     scale: float,
     kv_lora_rank: int,
+    layer: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Chunked MLA prefill WITH prior context: chunk queries attend over
     the whole paged latent history (earlier chunks + this one) with
@@ -161,13 +180,14 @@ def mla_paged_context_attention(
     paged_context_attention.  Uses the absorption form so per-token K/V
     are never materialized."""
     B, T, H, dn = q_nope.shape
-    _, _, ps, dtot = cache_latent.shape
+    ps, _, dtot = cache_latent.shape[-3:]
     dl = kv_lora_rank
     pmax = page_tables.shape[1]
     S = pmax * ps
     dv = kv_b_v.shape[1] // H
 
-    lat = cache_latent[page_tables][:, :, 0]       # [B, pmax, ps, dl+dr]
+    cache_latent, base = _layer_view(cache_latent, layer)
+    lat = cache_latent[base + page_tables][:, :, :, 0]  # [B, pmax, ps, dl+dr]
     lat = lat.reshape(B, S, dtot)
     c_kv, k_rope = lat[..., :dl], lat[..., dl:]
 
@@ -193,7 +213,7 @@ def mla_paged_context_attention(
 def mla_paged_decode_attention(
     q_nope: jax.Array,       # [B, H, dn]
     q_rope: jax.Array,       # [B, H, dr]
-    cache_latent: jax.Array,  # [P, 1, ps, dl+dr]
+    cache_latent: jax.Array,  # [P, ps, 1, dl+dr]
     page_tables: jax.Array,  # [B, pmax]
     lengths: jax.Array,      # [B]
     kv_b_k: jax.Array,       # [dl, H*dn]
@@ -201,6 +221,7 @@ def mla_paged_decode_attention(
     *,
     scale: float,
     kv_lora_rank: int,
+    layer: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Decode attention over the paged latent cache.
 
@@ -211,13 +232,14 @@ def mla_paged_decode_attention(
     memory win).
     """
     B, H, dn = q_nope.shape
-    _, _, ps, dtot = cache_latent.shape
+    ps, _, dtot = cache_latent.shape[-3:]
     dl = kv_lora_rank
     pmax = page_tables.shape[1]
     S = pmax * ps
     dv = kv_b_v.shape[1] // H
 
-    lat = cache_latent[page_tables][:, :, 0]       # [B, pmax, ps, dl+dr]
+    cache_latent, base = _layer_view(cache_latent, layer)
+    lat = cache_latent[base + page_tables][:, :, :, 0]  # [B, pmax, ps, dl+dr]
     lat = lat.reshape(B, S, dtot)
     c_kv, k_rope = lat[..., :dl], lat[..., dl:]
 
@@ -239,7 +261,7 @@ def mla_paged_decode_attention(
 
 def paged_decode_attention(
     q: jax.Array,            # [B, H, D] (one new token per sequence)
-    cache_k: jax.Array,      # [num_pages, Hkv, page_size, D]
+    cache_k: jax.Array,      # [num_pages, page_size, Hkv, D]
     cache_v: jax.Array,
     page_tables: jax.Array,  # [B, pages_per_seq]
     lengths: jax.Array,      # [B] tokens in cache INCLUDING the new one
@@ -247,20 +269,23 @@ def paged_decode_attention(
     scale: float,
     sliding_window: Optional[int] = None,
     logit_softcap: Optional[float] = None,
+    layer: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Attend one query token per sequence over its paged KV history
     (pure-JAX reference; the Pallas kernel in engine.ops implements the
     same contract)."""
     B, H, D = q.shape
-    _, Hkv, ps, _ = cache_k.shape
+    ps, Hkv, _ = cache_k.shape[-3:]
     pmax = page_tables.shape[1]
     S = pmax * ps
     groups = H // Hkv
 
-    k = cache_k[page_tables]                      # [B, pmax, Hkv, ps, D]
-    v = cache_v[page_tables]
-    k = jnp.moveaxis(k, 2, 3).reshape(B, S, Hkv, D)
-    v = jnp.moveaxis(v, 2, 3).reshape(B, S, Hkv, D)
+    cache_k, base = _layer_view(cache_k, layer)
+    cache_v, _ = _layer_view(cache_v, layer)
+    k = cache_k[base + page_tables]               # [B, pmax, ps, Hkv, D]
+    v = cache_v[base + page_tables]
+    k = k.reshape(B, S, Hkv, D)
+    v = v.reshape(B, S, Hkv, D)
 
     qg = q.reshape(B, Hkv, groups, D)
     scores = jnp.einsum("bkgd,bskd->bkgs", qg, k, preferred_element_type=jnp.float32)

@@ -382,11 +382,11 @@ class InferenceEngine:
         from jax.sharding import NamedSharding
         from jax.sharding import PartitionSpec as P
 
-        # [L, pages, kv_heads, page_size, D]: shard the kv-head axis
+        # [L, pages, page_size, kv_heads, D]: shard the kv-head axis
         # (replicated when MLA's single latent stream can't split)
         if self.md.arch.kv_cache_heads % self.mesh.shape["tensor"] == 0 \
                 and self.md.arch.kv_cache_heads > 1:
-            return NamedSharding(self.mesh, P(None, None, "tensor"))
+            return NamedSharding(self.mesh, P(None, None, None, "tensor"))
         return NamedSharding(self.mesh, P())
 
     def _init_params(self):

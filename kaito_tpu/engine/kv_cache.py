@@ -1,15 +1,22 @@
 """Paged KV cache.
 
 The engine's KV memory is a global page pool per layer —
-``[num_layers, num_pages, kv_heads, page_size, head_dim]`` — addressed
+``[num_layers, num_pages, page_size, kv_heads, head_dim]`` — addressed
 through per-sequence page tables, vLLM-style but with static shapes
 throughout so XLA compiles one program per (bucket, batch) shape.  The
 reference delegates this entirely to vLLM's PagedAttention
 (SURVEY.md §2.3); on TPU we own it.
 
-The page-major layout makes each page one contiguous
-``[kv_heads, page_size, head_dim]`` block in HBM — a single clean
-leading-index DMA per page in the Pallas decode kernel.
+The layout is page-major and TOKEN-major within a page: each page is
+one contiguous ``[page_size, kv_heads, head_dim]`` block in HBM (a
+single clean leading-index DMA per page in the Pallas decode kernel)
+and each token's row is one ``[kv_heads, head_dim]`` tile.  That tile
+is exactly what a decode step writes, so the write is a scatter whose
+update window is minor-dim-contiguous — XLA keeps the default layout
+for it.  (With the head-major order the scatter preferred a transposed
+layout while the Mosaic custom call pinned the default one, and XLA
+reconciled them with a full-cache copy per layer: 64 GiB/step of pure
+layout conversion at phi-4-mini bench shapes.)
 
 Page 0 is reserved as the null page: unused page-table slots point at
 it, so gathers are always in-bounds and masking is done by length, not
@@ -34,7 +41,7 @@ NULL_PAGE = 0
 class KVCache:
     """Stacked per-layer page pools (a pytree; donate on every step)."""
 
-    k: jax.Array  # [L, num_pages, kv_heads, page_size, head_dim]
+    k: jax.Array  # [L, num_pages, page_size, kv_heads, head_dim]
     v: jax.Array
 
     @property
@@ -43,7 +50,7 @@ class KVCache:
 
     @property
     def page_size(self) -> int:
-        return self.k.shape[3]
+        return self.k.shape[2]
 
 
 def create_kv_cache(
@@ -52,7 +59,7 @@ def create_kv_cache(
     page_size: int,
     dtype: jnp.dtype = jnp.bfloat16,
 ) -> KVCache:
-    shape = (arch.num_layers, num_pages, arch.kv_cache_heads, page_size,
+    shape = (arch.num_layers, num_pages, page_size, arch.kv_cache_heads,
              arch.kv_cache_dim)
     if arch.attention_kind.value == "MLA":
         # MLA caches one latent stream; `k` holds it, `v` is a
@@ -63,15 +70,23 @@ def create_kv_cache(
 
 
 def write_prefill_tokens(
-    cache_layer: jax.Array,       # [num_pages, Hkv, page_size, D]
+    cache_layer: jax.Array,       # [num_pages, ps, Hkv, D] or, with
+                                  # ``layer``, the stacked group [Lg, P, ps, Hkv, D]
     new: jax.Array,               # [B, T, Hkv, D]
     page_tables: jax.Array,       # [B, pages_per_seq] int32
     start_pos: jax.Array,         # [B] sequence position of new[:, 0]
     true_lens: jax.Array,         # [B] valid tokens per row; pad -> null page
     page_size: int,
+    layer: Optional[jax.Array] = None,   # scalar layer index into the stack
 ) -> jax.Array:
     """Scatter a batch of prefill chunks into their pages in one flat
-    scatter (a vmap would fork the shared pool buffer per row)."""
+    scatter (a vmap would fork the shared pool buffer per row).
+
+    With ``layer``, the stacked group cache is updated in place at that
+    layer — the form the serve path uses so the cache can ride the layer
+    scan as a *carry* (in-place scatter) instead of as stacked ys, which
+    copied the full pool every step (round-2 perf finding: 13.9 ms of a
+    31 ms decode step was cache copies)."""
     B, T = new.shape[:2]
     t = jnp.arange(T, dtype=jnp.int32)[None, :]
     pos = start_pos[:, None] + t                                  # [B, T]
@@ -80,20 +95,39 @@ def write_prefill_tokens(
     page_idx = jnp.where(valid, page_idx, NULL_PAGE)
     offset = pos % page_size
     flat = new.reshape(B * T, *new.shape[2:])                      # [B*T, Hkv, D]
-    return cache_layer.at[page_idx.reshape(-1), :, offset.reshape(-1)].set(flat)
+    if layer is None:
+        return cache_layer.at[page_idx.reshape(-1), offset.reshape(-1)].set(flat)
+    return cache_layer.at[layer, page_idx.reshape(-1), offset.reshape(-1)].set(flat)
+
+
+def decode_write_targets(
+    page_tables: jax.Array,       # [B, pages_per_seq]
+    positions: jax.Array,         # [B] position of each new token
+    page_size: int,
+    active: Optional[jax.Array] = None,
+) -> tuple[jax.Array, jax.Array]:
+    """(page, offset) each sequence's new decode token lands at.
+    Inactive rows target the null page (harmless scratch writes), the
+    same convention write_decode_tokens uses."""
+    page_idx = jnp.take_along_axis(
+        page_tables, (positions // page_size)[:, None], axis=1)[:, 0]
+    if active is not None:
+        page_idx = jnp.where(active, page_idx, NULL_PAGE)
+    return page_idx, positions % page_size
 
 
 def write_decode_tokens(
-    cache_layer: jax.Array,       # [num_pages, Hkv, page_size, D]
+    cache_layer: jax.Array,       # [num_pages, ps, Hkv, D] or, with
+                                  # ``layer``, the stacked group [Lg, P, ps, Hkv, D]
     new: jax.Array,               # [B, Hkv, D] one token per sequence
     page_tables: jax.Array,       # [B, pages_per_seq]
     positions: jax.Array,         # [B] current position of each new token
     page_size: int,
     active: Optional[jax.Array] = None,  # [B] bool; inactive rows hit page 0
+    layer: Optional[jax.Array] = None,   # scalar layer index into the stack
 ) -> jax.Array:
-    page_idx = jnp.take_along_axis(
-        page_tables, (positions // page_size)[:, None], axis=1)[:, 0]
-    if active is not None:
-        page_idx = jnp.where(active, page_idx, NULL_PAGE)
-    offset = positions % page_size
-    return cache_layer.at[page_idx, :, offset].set(new)
+    page_idx, offset = decode_write_targets(page_tables, positions,
+                                            page_size, active)
+    if layer is None:
+        return cache_layer.at[page_idx, offset].set(new)
+    return cache_layer.at[layer, page_idx, offset].set(new)

@@ -265,11 +265,15 @@ class TransformerLM:
     # MLA (DeepSeek-style latent attention)
     # ------------------------------------------------------------------
 
-    def _mla_attention(self, h, p, ck, cv, mode, *, positions, page_tables,
-                       lengths, true_lens, active, start_pos=None):
+    def _mla_attention(self, h, p, ck, cv, li, mode, *, positions,
+                       page_tables, lengths, true_lens, active,
+                       start_pos=None):
         """Latent attention: project to a shared compressed KV latent,
         cache only [c_kv ; k_rope], expand per-head K/V on use (prefill)
-        or absorb projections into the query (decode)."""
+        or absorb projections into the query (decode).
+
+        ``ck`` is the full layer-group latent cache [Lg, P, ps, 1, dl+dr]
+        riding the layer scan as a carry; ``li`` selects this layer."""
         a = self.arch
         B, T, E = h.shape
         H = a.num_heads
@@ -297,30 +301,30 @@ class TransformerLM:
                 q_nope, q_rope, c_kv, k_rope, p["kv_b_k"], p["kv_b_v"],
                 scale=self._scale, true_len=true_lens)
         elif mode == "prefill":
-            ps = ck.shape[-2]
+            ps = ck.shape[-3]
             start = (start_pos if start_pos is not None
                      else jnp.zeros((B,), jnp.int32))
             ck = write_prefill_tokens(ck, latent[:, :, None, :], page_tables,
-                                      start, true_lens, ps)
+                                      start, true_lens, ps, layer=li)
             if start_pos is not None:
                 # chunked prefill: attend over the paged latent history
                 # (earlier chunks) + this chunk, absolute positions
                 out = attn.mla_paged_context_attention(
                     q_nope, q_rope, ck, page_tables, start, true_lens,
                     p["kv_b_k"], p["kv_b_v"], scale=self._scale,
-                    kv_lora_rank=dl)
+                    kv_lora_rank=dl, layer=li)
             else:
                 out = attn.mla_prefill_attention(
                     q_nope, q_rope, c_kv, k_rope, p["kv_b_k"], p["kv_b_v"],
                     scale=self._scale, true_len=true_lens)
         else:
-            ps = ck.shape[-2]
+            ps = ck.shape[-3]
             ck = write_decode_tokens(ck, latent[:, 0][:, None, :], page_tables,
-                                     positions[:, 0], ps, active)
+                                     positions[:, 0], ps, active, layer=li)
             out = attn.mla_paged_decode_attention(
                 q_nope[:, 0], q_rope[:, 0], ck, page_tables, lengths,
                 p["kv_b_k"], p["kv_b_v"], scale=self._scale,
-                kv_lora_rank=dl)[:, None]
+                kv_lora_rank=dl, layer=li)[:, None]
         dv = a.v_head_dim or a.head_dim
         attn_out = out.reshape(B, T, H * dv) @ p["o"]
         return attn_out, ck, cv
@@ -378,16 +382,23 @@ class TransformerLM:
             return nn.layer_norm(x, p[name], p.get(f"{name}_bias"), self.arch.rms_norm_eps)
         return nn.rms_norm(x, p[name], self.arch.rms_norm_eps, self.arch.norm_offset)
 
-    def _layer(self, x, p, ck, cv, window, moe, mode, *,
+    def _layer(self, x, p, ck, cv, li, window, moe, mode, *,
                positions, page_tables, lengths, true_lens, active,
                start_pos=None, lora=None, lora_ids=None):
-        """One transformer block. Returns (x, ck, cv)."""
+        """One transformer block. Returns (x, ck, cv).
+
+        ``ck``/``cv`` are the FULL layer-group page pools
+        [Lg, P, ps, Hkv, D] riding the layer scan as a carry; ``li`` is
+        this layer's index into them.  Writes are in-place scatters on
+        the carry and attention reads gather straight from the big
+        buffer — neither materializes a per-layer slice (which cost
+        ~14 ms/step when the cache rode the scan as stacked ys)."""
         a = self.arch
         B, T, E = x.shape
         h = self._norm(x, p, "attn_norm")
         if self.is_mla:
             attn_out, ck, cv = self._mla_attention(
-                h, p, ck, cv, mode, positions=positions,
+                h, p, ck, cv, li, mode, positions=positions,
                 page_tables=page_tables, lengths=lengths,
                 true_lens=true_lens, active=active, start_pos=start_pos)
             if a.parallel_residual:
@@ -397,19 +408,21 @@ class TransformerLM:
             return x + self._mlp(h2, p, moe), ck, cv
         q, k_new, v_new = self._attn_qkv(h, p, positions, window,
                                          lora=lora, lora_ids=lora_ids)
-        ps = ck.shape[-2]
+        ps = ck.shape[-3]
 
         if mode == "prefill":
             start = (start_pos if start_pos is not None
                      else jnp.zeros((B,), jnp.int32))
-            ck = write_prefill_tokens(ck, k_new, page_tables, start, true_lens, ps)
-            cv = write_prefill_tokens(cv, v_new, page_tables, start, true_lens, ps)
+            ck = write_prefill_tokens(ck, k_new, page_tables, start,
+                                      true_lens, ps, layer=li)
+            cv = write_prefill_tokens(cv, v_new, page_tables, start,
+                                      true_lens, ps, layer=li)
             if start_pos is not None:
                 # chunk attends over cached context + itself (prefix reuse)
                 out = attn.paged_context_attention(
                     q, ck, cv, page_tables, start, true_lens,
                     scale=self._scale, sliding_window=window,
-                    logit_softcap=a.attn_logit_softcap)
+                    logit_softcap=a.attn_logit_softcap, layer=li)
             elif self.attn_impl == "pallas":
                 from kaito_tpu.engine.ops.flash_prefill import (
                     flash_prefill_attention)
@@ -425,9 +438,9 @@ class TransformerLM:
                     true_len=true_lens)
         else:
             ck = write_decode_tokens(ck, k_new[:, 0], page_tables,
-                                     positions[:, 0], ps, active)
+                                     positions[:, 0], ps, active, layer=li)
             cv = write_decode_tokens(cv, v_new[:, 0], page_tables,
-                                     positions[:, 0], ps, active)
+                                     positions[:, 0], ps, active, layer=li)
             if self.attn_impl == "pallas":
                 from kaito_tpu.engine.ops.decode_attention import (
                     paged_decode_attention_pallas)
@@ -436,11 +449,12 @@ class TransformerLM:
                 out = paged_decode_attention_pallas(
                     q[:, 0], ck, cv, page_tables, lengths,
                     jnp.asarray(win, jnp.int32), scale=self._scale,
-                    softcap=a.attn_logit_softcap)
+                    softcap=a.attn_logit_softcap, layer=li)
             else:
                 out = attn.paged_decode_attention(
                     q[:, 0], ck, cv, page_tables, lengths, scale=self._scale,
-                    sliding_window=window, logit_softcap=a.attn_logit_softcap)
+                    sliding_window=window, logit_softcap=a.attn_logit_softcap,
+                    layer=li)
             out = out[:, None]
         o_in = out.reshape(B, T, a.num_heads * a.head_dim)
         attn_out = nn.linear(o_in, p["o"]) + nn.lora_delta(o_in, p, "o", self.lora_scaling) \
@@ -487,6 +501,11 @@ class TransformerLM:
                 x, _ = jax.lax.scan(body, x, xs)
                 continue
 
+            # The group's page pools ride the scan as a CARRY: writes are
+            # in-place scatters at a traced layer index and attention
+            # gathers straight from the big buffer.  (Threading them as
+            # xs/ys sliced + re-stacked the full pool every step — 14 ms
+            # of a 31 ms decode step on a v5e chip.)
             ck_g = cache.k[g.start:g.start + g.count]
             cv_g = cache.v[g.start:g.start + g.count]
             # per-request adapters ride the scan as an extra [L, n, ...]
@@ -495,24 +514,36 @@ class TransformerLM:
             has_lora = bool(lora_g)
 
             def body(carry, xs, moe=g.moe, has_lora=has_lora):
-                h = carry
+                h, ck_g, cv_g = carry
                 items = list(xs)
-                p, ck_l, cv_l = items[0], items[1], items[2]
-                lora_l = items[3] if has_lora else None
+                li, p = items[0], items[1]
+                lora_l = items[2] if has_lora else None
                 window = items[-1] if flags is not None else None
-                h, ck_l, cv_l = self._layer(
-                    h, p, ck_l, cv_l, window, moe, mode,
+                h, ck_g, cv_g = self._layer(
+                    h, p, ck_g, cv_g, li, window, moe, mode,
                     positions=positions, page_tables=page_tables,
                     lengths=lengths, true_lens=true_lens, active=active,
                     start_pos=start_pos, lora=lora_l, lora_ids=adapter_ids)
-                return h, (ck_l, cv_l)
+                return (h, ck_g, cv_g), None
 
-            xs = (stack, ck_g, cv_g)
+            # scan length follows the actual stack: pipeline stages pass
+            # stage-local views whose leading axis is a fraction of the
+            # arch's layer count
+            Lg = jax.tree.leaves(stack)[0].shape[0]
+            xs = (jnp.arange(Lg, dtype=jnp.int32), stack)
             if has_lora:
                 xs = xs + (lora_g,)
             if flags is not None:
-                xs = xs + (flags,)
-            x, (ck_new, cv_new) = jax.lax.scan(body, x, xs)
+                pat = self.arch.sliding_window_pattern
+                if Lg != g.count and pat and Lg % pat:
+                    # flags[:Lg] only equals every stage's own flags when
+                    # the global/local pattern tiles the stage evenly
+                    raise NotImplementedError(
+                        f"pipeline stage of {Lg} layers does not tile the "
+                        f"sliding-window pattern ({pat}); per-stage window "
+                        f"flags are not implemented")
+                xs = xs + (flags[:Lg],)
+            (x, ck_new, cv_new), _ = jax.lax.scan(body, (x, ck_g, cv_g), xs)
             new_k.append(ck_new)
             new_v.append(cv_new)
         if mode == "train":
@@ -528,7 +559,7 @@ class TransformerLM:
         h = self._norm(x, p, "attn_norm")
         if self.is_mla:
             attn_out, _, _ = self._mla_attention(
-                h, p, None, None, "train", positions=positions,
+                h, p, None, None, None, "train", positions=positions,
                 page_tables=None, lengths=None, true_lens=true_lens,
                 active=None)
             if a.parallel_residual:

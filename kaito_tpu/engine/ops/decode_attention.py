@@ -1,15 +1,39 @@
 """Pallas TPU kernel: paged decode attention.
 
 One grid program per sequence.  Each loop iteration DMAs one page of K
-and V for *all* KV heads (the page-major cache layout makes a page one
-contiguous ``[Hkv, page_size, D]`` block) into a 4-deep VMEM ring while the previous page's flash-attention block
-(online softmax, batched over KV heads on the MXU) computes.  HBM
-traffic is exactly one read of the live KV — the decode roofline.
+and V for *all* KV heads (the token-major cache layout makes a page one
+contiguous ``[page_size * Hkv, D]`` panel) into a 4-deep VMEM ring
+while the previous page's flash-attention block computes.  HBM traffic
+is exactly one read of the live KV — the decode roofline.
+
+Compute is the *flat cross-head* formulation: scores for ALL query
+heads against ALL of the page's rows in one MXU matmul
+``[H, D] @ [ps*Hkv, D]^T -> [H, ps*Hkv]``, with GQA head-matching
+applied as a -inf mask so mismatched (query-head, kv-head) entries drop
+out of the online softmax exactly (exp(-inf) = 0 contributes nothing to
+the running sum, and the PV pass ``[H, ps*Hkv] @ [ps*Hkv, D]`` sees
+zeros there).  This wastes Hkv× MXU FLOPs — which are free at decode
+sizes — to buy a kernel with NO transposes, reshapes, or batched dots:
+Mosaic compiles only leading-batch/2-D dots well, and an in-kernel
+``[ps, Hkv, D] -> [Hkv, ps, D]`` transpose doubled the kernel's cost.
+
+The cache layout is token-major within a page (see engine.kv_cache):
+each decode-step KV write is then a scatter whose update window is one
+minor-contiguous ``[Hkv, D]`` tile, which XLA keeps in the default
+layout — the same layout this kernel pins for its operands.  (With the
+head-major order the scatter preferred a transposed layout and XLA
+reconciled the two with a full-cache copy per layer: 64 GiB/step of
+pure layout conversion at phi-4-mini bench shapes.)
+
+With ``layer`` the caches are the FULL stacked layer group and the
+kernel DMAs pages of that layer straight out of the big buffer — no
+per-layer slice is ever materialized (feeding per-layer slices through
+the scan cost more than the kernel itself).
 
 Supports GQA (grouped queries), sliding windows (traced per-layer
 window sizes from the model's scan flags), and gemma-2 logit softcap.
 The pure-JAX fallback in kaito_tpu.engine.attention implements the same
-contract; tests compare the two in interpreter mode.
+contract; tests compare the two in interpreter mode and on-chip.
 """
 
 from __future__ import annotations
@@ -31,32 +55,40 @@ def _decode_kernel(
     page_tables_ref,   # [B, pmax] SMEM
     lengths_ref,       # [B] SMEM
     window_ref,        # [1] SMEM
+    layer_ref,         # [1] SMEM layer index into the stacked cache
     # inputs
-    q_ref,             # [1, Hkv, G, D] VMEM (pre-scaled)
-    k_hbm,             # [P, Hkv, ps, D] ANY/HBM
+    q_ref,             # [1, H, D] VMEM (pre-scaled)
+    k_hbm,             # [Lg, P, ps*Hkv, D] ANY/HBM (full group stack)
     v_hbm,
     # outputs
-    o_ref,             # [1, Hkv, G, D] VMEM
+    o_ref,             # [1, H, D] VMEM
     # scratch
-    k_buf,             # [N_BUF, Hkv, ps, D] VMEM
+    k_buf,             # [N_BUF, ps*Hkv, D] VMEM
     v_buf,
     sems,              # [N_BUF, 2] DMA semaphores
     *,
     page_size: int,
+    num_kv: int,
     softcap: Optional[float],
 ):
     b = pl.program_id(0)
     length = lengths_ref[b]
     window = window_ref[0]
+    li = layer_ref[0]
     n_pages = pl.cdiv(length, page_size)
+    H = q_ref.shape[1]
+    G = H // num_kv
+    cols = page_size * num_kv
 
     def k_dma(slot, p):
         return pltpu.make_async_copy(
-            k_hbm.at[page_tables_ref[b, p]], k_buf.at[slot], sems.at[slot, 0])
+            k_hbm.at[li, page_tables_ref[b, p]], k_buf.at[slot],
+            sems.at[slot, 0])
 
     def v_dma(slot, p):
         return pltpu.make_async_copy(
-            v_hbm.at[page_tables_ref[b, p]], v_buf.at[slot], sems.at[slot, 1])
+            v_hbm.at[li, page_tables_ref[b, p]], v_buf.at[slot],
+            sems.at[slot, 1])
 
     for i in range(N_BUF):
         @pl.when(i < n_pages)
@@ -64,8 +96,13 @@ def _decode_kernel(
             k_dma(i, i).start()
             v_dma(i, i).start()
 
-    q = q_ref[0]                      # [Hkv, G, D]
-    Hkv, G, D = q.shape
+    q2 = q_ref[0]                                  # [H, D]
+    # score-panel coordinates: column t*Hkv + h' is page row t, kv head
+    # h'; query row h*G+g matches kv head h
+    row_kv = jax.lax.broadcasted_iota(jnp.int32, (H, cols), 0) // G
+    col_kv = jax.lax.broadcasted_iota(jnp.int32, (H, cols), 1) % num_kv
+    col_t = jax.lax.broadcasted_iota(jnp.int32, (H, cols), 1) // num_kv
+    head_ok = row_kv == col_kv
 
     def body(p, carry):
         m, l, acc = carry
@@ -73,27 +110,25 @@ def _decode_kernel(
 
         k_dma(slot, p).wait()
         v_dma(slot, p).wait()
-        k = k_buf[slot]               # [Hkv, ps, D]
-        v = v_buf[slot]
+        k2 = k_buf[slot]                           # [ps*Hkv, D]
+        v2 = v_buf[slot]
 
-        # scores: batched over kv heads on the MXU
         s = jax.lax.dot_general(
-            q, k, (((2,), (2,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32)          # [Hkv, G, ps]
+            q2, k2, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)    # [H, ps*Hkv]
         if softcap:
             s = jnp.tanh(s / softcap) * softcap
-        pos = p * page_size + jax.lax.broadcasted_iota(
-            jnp.int32, (1, 1, page_size), 2)
-        valid = (pos < length) & (pos >= length - window)
+        pos = p * page_size + col_t
+        valid = head_ok & (pos < length) & (pos >= length - window)
         s = jnp.where(valid, s, NEG_INF)
 
-        m_new = jnp.maximum(m, jnp.max(s, axis=2, keepdims=True))
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
         alpha = jnp.exp(m - m_new)
         p_ij = jnp.exp(s - m_new)
-        l_new = l * alpha + jnp.sum(p_ij, axis=2, keepdims=True)
+        l_new = l * alpha + jnp.sum(p_ij, axis=1, keepdims=True)
         pv = jax.lax.dot_general(
-            p_ij.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32)          # [Hkv, G, D]
+            p_ij.astype(v2.dtype), v2, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)    # [H, D]
 
         # refill the slot we just consumed
         @pl.when(p + N_BUF < n_pages)
@@ -102,9 +137,10 @@ def _decode_kernel(
             v_dma(slot, p + N_BUF).start()
         return m_new, l_new, acc * alpha + pv
 
-    m0 = jnp.full((Hkv, G, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((Hkv, G, 1), jnp.float32)
-    acc0 = jnp.zeros((Hkv, G, D), jnp.float32)
+    D = q_ref.shape[2]
+    m0 = jnp.full((H, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((H, 1), jnp.float32)
+    acc0 = jnp.zeros((H, D), jnp.float32)
     m, l, acc = jax.lax.fori_loop(0, n_pages, body, (m0, l0, acc0))
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
@@ -114,7 +150,7 @@ def _decode_kernel(
     static_argnames=("scale", "softcap", "interpret"))
 def paged_decode_attention_pallas(
     q: jax.Array,            # [B, H, D]
-    cache_k: jax.Array,      # [P, Hkv, ps, D]
+    cache_k: jax.Array,      # [P, ps, Hkv, D] or [Lg, P, ps, Hkv, D] w/ layer
     cache_v: jax.Array,
     page_tables: jax.Array,  # [B, pmax] int32
     lengths: jax.Array,      # [B] int32
@@ -123,36 +159,46 @@ def paged_decode_attention_pallas(
     scale: float,
     softcap: Optional[float] = None,
     interpret: bool = False,
+    layer: Optional[jax.Array] = None,
 ) -> jax.Array:
     B, H, D = q.shape
-    P, Hkv, ps, _ = cache_k.shape
-    G = H // Hkv
-    q_grouped = (q * scale).reshape(B, Hkv, G, D)
+    if layer is None:
+        cache_k = cache_k[None]
+        cache_v = cache_v[None]
+        layer = jnp.zeros((), jnp.int32)
+    Lg, P, ps, Hkv, _ = cache_k.shape
+    # token-flat page view [Lg, P, ps*Hkv, D]: free reshape, and the
+    # page DMA plus both kernel dots run on it without any relayout
+    ck_flat = cache_k.reshape(Lg, P, ps * Hkv, D)
+    cv_flat = cache_v.reshape(Lg, P, ps * Hkv, D)
+    q_scaled = q * scale
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=4,
         grid=(B,),
         in_specs=[
-            pl.BlockSpec((1, Hkv, G, D), lambda b, *_: (b, 0, 0, 0)),
+            pl.BlockSpec((1, H, D), lambda b, *_: (b, 0, 0)),
             pl.BlockSpec(memory_space=pltpu.ANY),
             pl.BlockSpec(memory_space=pltpu.ANY),
         ],
-        out_specs=pl.BlockSpec((1, Hkv, G, D), lambda b, *_: (b, 0, 0, 0)),
+        out_specs=pl.BlockSpec((1, H, D), lambda b, *_: (b, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((N_BUF, Hkv, ps, D), cache_k.dtype),
-            pltpu.VMEM((N_BUF, Hkv, ps, D), cache_v.dtype),
+            pltpu.VMEM((N_BUF, ps * Hkv, D), cache_k.dtype),
+            pltpu.VMEM((N_BUF, ps * Hkv, D), cache_v.dtype),
             pltpu.SemaphoreType.DMA((N_BUF, 2)),
         ],
     )
 
-    kernel = functools.partial(_decode_kernel, page_size=ps, softcap=softcap)
+    kernel = functools.partial(_decode_kernel, page_size=ps, num_kv=Hkv,
+                               softcap=softcap)
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, Hkv, G, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(page_tables, lengths, jnp.reshape(window, (1,)),
-      q_grouped, cache_k, cache_v)
-    return out.reshape(B, H, D)
+      jnp.reshape(layer, (1,)).astype(jnp.int32),
+      q_scaled, ck_flat, cv_flat)
+    return out

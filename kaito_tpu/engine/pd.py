@@ -35,7 +35,7 @@ logger = logging.getLogger(__name__)
 def export_kv(cache: KVCache, pages: list[int]) -> tuple[dict, bytes]:
     """Gather a request's pages to host. Returns (meta, payload)."""
     idx = jnp.asarray(pages, jnp.int32)
-    k = np.asarray(cache.k[:, idx])      # [L, n, Hkv, ps, D]
+    k = np.asarray(cache.k[:, idx])      # [L, n, ps, Hkv, D]
     v = np.asarray(cache.v[:, idx])
     meta = {"shape": list(k.shape), "dtype": str(k.dtype)}
     buf = io.BytesIO()

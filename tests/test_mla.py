@@ -47,7 +47,7 @@ def _setup(batch=1):
 def test_mla_cache_holds_latent_only():
     arch, model, params, cache, pt = _setup()
     # cache "k" is the latent stream: 1 head, kv_lora+rope wide
-    assert cache.k.shape == (3, 64, 1, PS, 32 + 16)
+    assert cache.k.shape == (3, 64, PS, 1, 32 + 16)
     assert cache.v.shape[-1] == 0
     assert arch.kv_bytes_per_token(4) == 3 * (32 + 16) * 4
 

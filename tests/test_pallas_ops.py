@@ -14,8 +14,8 @@ def _setup(B=3, Hkv=2, G=2, D=64, ps=16, pmax=6, P=32, seed=0):
     rng = np.random.RandomState(seed)
     H = Hkv * G
     q = jnp.asarray(rng.randn(B, H, D), jnp.float32)
-    ck = jnp.asarray(rng.randn(P, Hkv, ps, D), jnp.float32)
-    cv = jnp.asarray(rng.randn(P, Hkv, ps, D), jnp.float32)
+    ck = jnp.asarray(rng.randn(P, ps, Hkv, D), jnp.float32)
+    cv = jnp.asarray(rng.randn(P, ps, Hkv, D), jnp.float32)
     pt = np.zeros((B, pmax), np.int32)
     for b in range(B):
         pt[b] = rng.permutation(np.arange(1, P))[:pmax]
