@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Build + push both images and upgrade the chart release.
+#
+# Counterpart of the reference's hack/deploy helper; images here are
+# the Python manager and the TPU engine (JAX/libtpu base).
+set -euo pipefail
+
+REGISTRY=${REGISTRY:?set REGISTRY, e.g. gcr.io/my-project}
+TAG=${TAG:-$(git rev-parse --short HEAD)}
+NAMESPACE=${NAMESPACE:-kaito-system}
+cd "$(dirname "$0")/.."
+
+docker build -t "$REGISTRY/kaito-tpu-manager:$TAG" -f docker/manager/Dockerfile .
+docker build -t "$REGISTRY/kaito-tpu-engine:$TAG" -f docker/engine/Dockerfile .
+docker push "$REGISTRY/kaito-tpu-manager:$TAG"
+docker push "$REGISTRY/kaito-tpu-engine:$TAG"
+
+helm upgrade --install kaito-tpu charts/kaito-tpu \
+    --namespace "$NAMESPACE" --create-namespace \
+    --set image.repository="$REGISTRY/kaito-tpu-manager" \
+    --set image.tag="$TAG" \
+    --set engine.image="$REGISTRY/kaito-tpu-engine:$TAG" \
+    "$@"
+
+kubectl -n "$NAMESPACE" rollout status deploy/kaito-tpu-manager

@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Spin a kind cluster and run the manager LOCALLY against it.
+#
+# The fastest dev loop for controller work: CRDs + webhook config go
+# into kind, the manager process runs on your machine with the
+# KubeStore adapter pointed at kind's API server (kaito_tpu/k8s/),
+# so a plain `kubectl apply -f examples/...` drives your local code.
+set -euo pipefail
+
+CLUSTER=${CLUSTER:-kaito-dev}
+cd "$(dirname "$0")/.."
+
+if ! kind get clusters 2>/dev/null | grep -qx "$CLUSTER"; then
+    kind create cluster --name "$CLUSTER"
+fi
+kubectl config use-context "kind-$CLUSTER"
+
+kubectl apply -f config/crd/
+kubectl create namespace kaito-system --dry-run=client -o yaml | kubectl apply -f -
+
+echo "starting manager against kind-$CLUSTER (ctrl-c to stop)"
+exec python -m kaito_tpu.controllers.manager \
+    --kubeconfig "$HOME/.kube/config" \
+    --namespace kaito-system "$@"

@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# Poll an engine pod's HBM/KV picture while it serves.
+#
+# TPU counterpart of the reference's hack/monitor_gpu_memory.sh
+# (nvidia-smi poller): the engine exports its paged-KV state as
+# Prometheus gauges, which is the live HBM story on TPU.
+set -euo pipefail
+
+URL=${1:-http://localhost:5000}
+INTERVAL=${INTERVAL:-5}
+
+while true; do
+    ts=$(date +%H:%M:%S)
+    metrics=$(curl -sf -m 3 "$URL/metrics" || true)
+    if [ -z "$metrics" ]; then
+        echo "$ts  engine unreachable at $URL"
+    else
+        echo "$metrics" | awk -v ts="$ts" '
+            /^kaito:kv_pages_total/   {total=$2}
+            /^kaito:kv_pages_free/    {free=$2}
+            /^kaito:kv_page_size/     {psz=$2}
+            /^kaito:active_slots/     {slots=$2}
+            /^kaito:queue_len/        {q=$2}
+            END {
+                used = total - free
+                pct = total > 0 ? 100 * used / total : 0
+                printf "%s  kv pages %d/%d (%.0f%%)  page=%d tok  active=%d  queued=%d\n",
+                       ts, used, total, pct, psz, slots, q
+            }'
+    fi
+    sleep "$INTERVAL"
+done

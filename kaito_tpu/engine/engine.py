@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import collections
 import logging
+import os
 import queue
 import threading
 import time
@@ -436,8 +437,16 @@ class InferenceEngine:
             limit = stats["bytes_limit"] * HBM_UTILIZATION
             free = limit - stats["bytes_in_use"]
         except Exception:
-            # CPU / unknown backend: enough for max_num_seqs full contexts
-            return self.cfg.max_num_seqs * self.pages_per_seq + 1
+            if dev.platform == "cpu":
+                # host RAM: enough for max_num_seqs full contexts
+                return self.cfg.max_num_seqs * self.pages_per_seq + 1
+            # TPU backends that don't expose memory_stats (seen on the
+            # axon remote plugin): budget against a known per-chip HBM
+            # size instead of assuming unlimited — sizing for the seq
+            # cap OOMed a 16 GiB v5e at 7 GiB of weights
+            limit = float(os.environ.get(
+                "KAITO_HBM_BYTES", 16 * 1024 ** 3)) * HBM_UTILIZATION
+            free = limit
         weights = self.md.arch.param_count() * self.dtype.itemsize
         free = free - weights - PER_CHIP_OVERHEAD_BYTES
         pages = int(max(free, 0) // (bpt * self.cfg.page_size))

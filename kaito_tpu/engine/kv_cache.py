@@ -100,22 +100,6 @@ def write_prefill_tokens(
     return cache_layer.at[layer, page_idx.reshape(-1), offset.reshape(-1)].set(flat)
 
 
-def decode_write_targets(
-    page_tables: jax.Array,       # [B, pages_per_seq]
-    positions: jax.Array,         # [B] position of each new token
-    page_size: int,
-    active: Optional[jax.Array] = None,
-) -> tuple[jax.Array, jax.Array]:
-    """(page, offset) each sequence's new decode token lands at.
-    Inactive rows target the null page (harmless scratch writes), the
-    same convention write_decode_tokens uses."""
-    page_idx = jnp.take_along_axis(
-        page_tables, (positions // page_size)[:, None], axis=1)[:, 0]
-    if active is not None:
-        page_idx = jnp.where(active, page_idx, NULL_PAGE)
-    return page_idx, positions % page_size
-
-
 def write_decode_tokens(
     cache_layer: jax.Array,       # [num_pages, ps, Hkv, D] or, with
                                   # ``layer``, the stacked group [Lg, P, ps, Hkv, D]
@@ -126,8 +110,12 @@ def write_decode_tokens(
     active: Optional[jax.Array] = None,  # [B] bool; inactive rows hit page 0
     layer: Optional[jax.Array] = None,   # scalar layer index into the stack
 ) -> jax.Array:
-    page_idx, offset = decode_write_targets(page_tables, positions,
-                                            page_size, active)
+    page_idx = jnp.take_along_axis(
+        page_tables, (positions // page_size)[:, None], axis=1)[:, 0]
+    if active is not None:
+        # inactive rows target the null page (harmless scratch writes)
+        page_idx = jnp.where(active, page_idx, NULL_PAGE)
+    offset = positions % page_size
     if layer is None:
         return cache_layer.at[page_idx, offset].set(new)
     return cache_layer.at[layer, page_idx, offset].set(new)

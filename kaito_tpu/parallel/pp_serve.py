@@ -81,7 +81,7 @@ class PipelineServeExecutor:
         return jax.device_put(staged, shardings)
 
     def stage_cache(self, cache: KVCache) -> KVCache:
-        """[L, pages, ps, H, D] -> [S, L/S, pages, H, ps, D] sharded over
+        """[L, pages, ps, H, D] -> [S, L/S, pages, ps, H, D] sharded over
         the pipeline axis (each stage owns its layers' KV)."""
         S = self.num_stages
         sh = NamedSharding(self.mesh, P(self.axis))

@@ -1,0 +1,103 @@
+# GKE cluster with TPU support.  The system pool runs the operator;
+# TPU slices are created on demand per Workspace by the kaito-tpu
+# provisioner (node auto-provisioning keeps quota honest).
+
+resource "google_container_cluster" "kaito" {
+  name     = var.cluster_name
+  location = var.region
+
+  # operator + system workloads only; TPU pools are per-Workspace
+  remove_default_node_pool = true
+  initial_node_count       = 1
+
+  release_channel {
+    channel = "RAPID" # newest TPU runtime support
+  }
+
+  workload_identity_config {
+    workload_pool = "${var.project_id}.svc.id.goog"
+  }
+
+  cluster_autoscaling {
+    enabled = true
+    autoscaling_profile = "OPTIMIZE_UTILIZATION"
+    resource_limits {
+      resource_type = "cpu"
+      minimum       = 4
+      maximum       = var.max_cpu
+    }
+    resource_limits {
+      resource_type = "memory"
+      minimum       = 16
+      maximum       = var.max_memory_gb
+    }
+  }
+}
+
+resource "google_container_node_pool" "system" {
+  name     = "system"
+  cluster  = google_container_cluster.kaito.name
+  location = var.region
+
+  node_count = var.system_node_count
+
+  node_config {
+    machine_type = var.system_machine_type
+    oauth_scopes = ["https://www.googleapis.com/auth/cloud-platform"]
+    workload_metadata_config {
+      mode = "GKE_METADATA"
+    }
+  }
+}
+
+# example static TPU pool (BYO-provisioner path); per-Workspace pools
+# are normally created by the operator instead — see
+# kaito_tpu/provision/karpenter.py for the NodePool rendering
+resource "google_container_node_pool" "tpu_v5e_static" {
+  count    = var.create_static_tpu_pool ? 1 : 0
+  name     = "tpu-v5e-static"
+  cluster  = google_container_cluster.kaito.name
+  location = var.region
+
+  initial_node_count = 0
+  autoscaling {
+    min_node_count = 0
+    max_node_count = var.static_tpu_max_nodes
+  }
+
+  node_config {
+    machine_type = var.static_tpu_machine_type # e.g. ct5lp-hightpu-4t
+    oauth_scopes = ["https://www.googleapis.com/auth/cloud-platform"]
+    workload_metadata_config {
+      mode = "GKE_METADATA"
+    }
+    labels = {
+      "kaito.sh/byo-tpu" = "true"
+    }
+  }
+
+  placement_policy {
+    type         = "COMPACT"
+    tpu_topology = var.static_tpu_topology # e.g. "2x4"
+  }
+}
+
+# workload identity for GCS weight streaming (ModelMirror + the
+# engine's safetensors-over-GCS ranged reads; the GCS analogue of the
+# reference's SAS-token fetch)
+resource "google_service_account" "weights_reader" {
+  account_id   = "${var.cluster_name}-weights"
+  display_name = "kaito-tpu weight streaming reader"
+}
+
+resource "google_project_iam_member" "weights_reader_gcs" {
+  project = var.project_id
+  role    = "roles/storage.objectViewer"
+  member  = "serviceAccount:${google_service_account.weights_reader.email}"
+}
+
+resource "google_service_account_iam_member" "weights_wi" {
+  service_account_id = google_service_account.weights_reader.name
+  role               = "roles/iam.workloadIdentityUser"
+  member             = "serviceAccount:${var.project_id}.svc.id.goog[${var.namespace}/kaito-tpu-workload]"
+}

@@ -1,0 +1,27 @@
+# Install the kaito-tpu operator chart into the cluster.
+
+resource "helm_release" "kaito_tpu" {
+  name             = "kaito-tpu"
+  chart            = "${path.module}/../charts/kaito-tpu"
+  namespace        = var.namespace
+  create_namespace = true
+
+  set {
+    name  = "image.repository"
+    value = var.manager_image
+  }
+  set {
+    name  = "image.tag"
+    value = var.manager_tag
+  }
+  set {
+    name  = "provisioner.backend"
+    value = var.provisioner_backend # karpenter | byo
+  }
+  set {
+    name  = "webhook.enabled"
+    value = "true"
+  }
+
+  depends_on = [google_container_node_pool.system]
+}

@@ -1,0 +1,38 @@
+# GKE + TPU bootstrap for kaito-tpu.
+#
+# TPU-native counterpart of the reference's AKS bootstrap
+# (/root/reference/terraform/main.tf): instead of an AKS cluster with a
+# GPU VMSS + gpu-provisioner, this creates a GKE cluster wired for TPU
+# node auto-provisioning and installs the kaito-tpu chart.  The
+# operator then creates per-Workspace TPU node pools itself (karpenter
+# provisioner backend) with `cloud.google.com/gke-tpu-accelerator` and
+# `gke-tpu-topology` requirements from the planner.
+
+terraform {
+  required_version = ">= 1.5"
+  required_providers {
+    google = {
+      source  = "hashicorp/google"
+      version = ">= 5.30"
+    }
+    helm = {
+      source  = "hashicorp/helm"
+      version = ">= 2.12"
+    }
+  }
+}
+
+provider "google" {
+  project = var.project_id
+  region  = var.region
+}
+
+data "google_client_config" "default" {}
+
+provider "helm" {
+  kubernetes {
+    host                   = "https://${google_container_cluster.kaito.endpoint}"
+    token                  = data.google_client_config.default.access_token
+    cluster_ca_certificate = base64decode(google_container_cluster.kaito.master_auth[0].cluster_ca_certificate)
+  }
+}

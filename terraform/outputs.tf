@@ -1,0 +1,17 @@
+output "cluster_name" {
+  value = google_container_cluster.kaito.name
+}
+
+output "cluster_endpoint" {
+  value     = google_container_cluster.kaito.endpoint
+  sensitive = true
+}
+
+output "weights_service_account" {
+  value       = google_service_account.weights_reader.email
+  description = "Bind to pods that stream weights from GCS (workload identity)"
+}
+
+output "get_credentials" {
+  value = "gcloud container clusters get-credentials ${google_container_cluster.kaito.name} --region ${var.region} --project ${var.project_id}"
+}

@@ -1,0 +1,76 @@
+variable "project_id" {
+  type        = string
+  description = "GCP project hosting the cluster"
+}
+
+variable "region" {
+  type        = string
+  default     = "us-west4" # broad v5e availability
+  description = "Region with TPU capacity for the chip types you plan to serve"
+}
+
+variable "cluster_name" {
+  type    = string
+  default = "kaito-tpu"
+}
+
+variable "namespace" {
+  type    = string
+  default = "kaito-system"
+}
+
+variable "system_machine_type" {
+  type    = string
+  default = "e2-standard-4"
+}
+
+variable "system_node_count" {
+  type    = number
+  default = 2
+}
+
+variable "max_cpu" {
+  type    = number
+  default = 1024
+}
+
+variable "max_memory_gb" {
+  type    = number
+  default = 4096
+}
+
+variable "create_static_tpu_pool" {
+  type        = bool
+  default     = false
+  description = "Create a static TPU pool for the BYO-provisioner path instead of operator-managed pools"
+}
+
+variable "static_tpu_machine_type" {
+  type    = string
+  default = "ct5lp-hightpu-4t" # v5e, 4 chips/host
+}
+
+variable "static_tpu_topology" {
+  type    = string
+  default = "2x4" # v5e-8: two hosts
+}
+
+variable "static_tpu_max_nodes" {
+  type    = number
+  default = 4
+}
+
+variable "manager_image" {
+  type    = string
+  default = "ghcr.io/kaito-tpu/manager"
+}
+
+variable "manager_tag" {
+  type    = string
+  default = "latest"
+}
+
+variable "provisioner_backend" {
+  type    = string
+  default = "karpenter"
+}
