@@ -297,7 +297,11 @@ class InferenceEngine:
         self._sample_one = jax.jit(sample)
         ra = cfg.decode_run_ahead
         if ra is None:
-            ra = 8 if jax.default_backend() == "tpu" else 1
+            # fused steps amortize per-dispatch overhead (jit-cache
+            # walk, arg staging, runtime RPC on remote plugins); 16 is
+            # the measured knee on a v5e — beyond it, emission
+            # burstiness grows faster than the amortization gain
+            ra = 16 if jax.default_backend() == "tpu" else 1
         self.run_ahead = max(1, int(ra))
         self._decode_multi_fns: dict[int, object] = {}
 
@@ -708,6 +712,11 @@ class InferenceEngine:
                 self.prefix_cache.release_uncommitted(tokens, slot.pages)
         else:
             self.allocator.release(slot.pages)
+        # reset the sampling row to greedy/no-mask: the sampler's
+        # sort-skip and draw-skip gates read EVERY row, so one retired
+        # top-p request would otherwise defeat them forever
+        self.sampling = self.sampling.set_slot(
+            slot_idx, temperature=0.0, top_k=0, top_p=1.0, seed=0)
         slot.request = None
         slot.pages = []
         slot.prefilling = False
@@ -1198,8 +1207,10 @@ class InferenceEngine:
             # every slot finishes within the window: shrink the scan so
             # it doesn't burn full-batch steps past the last real token
             K = 1 << max(0, max_rem.bit_length() - 1)
-        if K > 1 and not self._lookahead_fits(K):
-            return 1
+        # halve under page pressure instead of dropping straight to
+        # single-step: the power-of-two buckets keep compile count low
+        while K > 1 and not self._lookahead_fits(K):
+            K //= 2
         return max(1, K)
 
     def _pages_needed(self, slot: "_Slot", lookahead: int) -> int:
