@@ -50,6 +50,7 @@ def main():
     ap.add_argument("--decode-steps", type=int, default=128)
     ap.add_argument("--repeats", type=int, default=3)
     ap.add_argument("--attn-impl", default="", choices=["", "jax", "pallas"])
+    ap.add_argument("--quant", default="", choices=["", "int8"])
     ap.add_argument("--deadline", type=float, default=1500.0)
     args = ap.parse_args()
     _watchdog(args.deadline)
@@ -87,8 +88,16 @@ def main():
     # 64 -> 3.8k, 96 -> 5.0k, 112 -> 5.5k tok/s; 128 OOMs).  main()
     # walks the ladder down on RESOURCE_EXHAUSTED so a fragmentation
     # hiccup degrades the number instead of zeroing it.
-    batch_ladder = ([args.batch] if args.batch
-                    else ([112, 96, 64] if on_tpu else [4]))
+    if args.batch:
+        batch_ladder = [args.batch]
+    elif not on_tpu:
+        batch_ladder = [4]
+    elif args.quant == "int8":
+        # int8 halves weight bytes -> deeper batches fit (measured:
+        # 112 -> 6.7k, 160 -> 7.3k, 224 -> 7.8k tok/s)
+        batch_ladder = [224, 160, 112, 64]
+    else:
+        batch_ladder = [112, 96, 64]
     dtype = jnp.bfloat16 if on_tpu else jnp.float32
     md = get_model_by_name(model_name)
     arch = md.arch
@@ -104,6 +113,15 @@ def main():
     jax.block_until_ready(params)
     log(f"params ready in {time.monotonic() - t0:.1f}s "
         f"({sum(x.nbytes for x in jax.tree.leaves(params)) / 2**30:.2f} GiB)")
+    if args.quant == "int8":
+        from functools import partial as _partial
+
+        from kaito_tpu.engine.quant import quantize_params
+
+        params = jax.jit(_partial(quantize_params, arch=arch))(params)
+        jax.block_until_ready(params)
+        log(f"int8 weights: "
+            f"{sum(x.nbytes for x in jax.tree.leaves(params)) / 2**30:.2f} GiB")
 
     page_size = 64
     total_len = args.prompt_len + args.decode_steps
@@ -250,8 +268,9 @@ def main():
         log(f"ttft measurement failed ({type(e).__name__}: {e}); omitting")
         ttft_ms = None
 
+    suffix = "_int8" if args.quant == "int8" else ""
     result = {
-        "metric": f"{model_name}_decode_throughput",
+        "metric": f"{model_name}{suffix}_decode_throughput",
         "value": round(best, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(best / 2000.0, 3),

@@ -26,6 +26,11 @@ class EngineConfig:
     prefill_buckets: tuple[int, ...] = (128, 256, 512, 1024, 2048, 4096)
     dtype: str = "bfloat16"
     kv_dtype: str = "bfloat16"
+    # weight-only quantization: "" (off) | "int8" (per-out-channel
+    # symmetric; dense GQA families).  Decode is param-bandwidth-bound,
+    # so int8 weights are a direct throughput lever; the reference's
+    # vLLM surface exposes the same knob as --quantization.
+    quantization: str = ""
     seed: int = 0
     tensor_parallel: int = 1             # TP degree (mesh "tensor" axis)
     expert_parallel: int = 1             # EP degree (mesh "expert" axis)

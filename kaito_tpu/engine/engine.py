@@ -188,6 +188,39 @@ class InferenceEngine:
         self.buckets = tuple(sorted(
             {b for b in cfg.prefill_buckets if b < cfg.max_model_len}
             | {cfg.max_model_len}))
+        if cfg.quantization:
+            # fail fast BEFORE any allocation or weight loading
+            if cfg.quantization != "int8":
+                raise ValueError(f"unknown quantization {cfg.quantization!r}")
+            if self.mesh is not None or self.pp_exec is not None:
+                raise ValueError(
+                    "int8 serving is single-chip this round (TP/PP shard "
+                    "rules for QTensor trees land with the next pass)")
+            from kaito_tpu.engine.quant import supports_quantization
+
+            if not supports_quantization(arch):
+                raise ValueError(
+                    "int8 serving currently covers dense GQA families only "
+                    "(MLA or MoE layers present)")
+
+        # params BEFORE the KV pool: sizing reads the ACTUAL resident
+        # weight bytes (post-quantization), and quantizing with a
+        # donated tree frees the bf16 weights before the pool claims
+        # the rest of HBM
+        self.params = params if params is not None else self._init_params()
+        if cfg.quantization:
+            from kaito_tpu.engine.quant import quantize_params
+
+            t0 = time.monotonic()
+            self.params = jax.jit(
+                partial(quantize_params, arch=self.md.arch),
+                donate_argnums=0)(self.params)
+            jax.block_until_ready(self.params)
+            logger.info(
+                "int8 weights ready in %.1fs (%.2f GiB)",
+                time.monotonic() - t0,
+                sum(x.nbytes for x in jax.tree.leaves(self.params)) / 2**30)
+
         num_pages = cfg.max_pages or self._derive_max_pages()
         num_pages = max(num_pages, cfg.max_num_seqs * self.pages_per_seq // 4 + 2)
         self._num_pages = num_pages
@@ -195,8 +228,6 @@ class InferenceEngine:
         logger.info("KV cache: %d pages x %d tokens (%.2f GiB)",
                     num_pages, cfg.page_size,
                     2 * self.cache.k.nbytes / 2**30)
-
-        self.params = params if params is not None else self._init_params()
         self.adapter_index: dict[str, int] = {}
         self.adapters_merged = False
         if cfg.adapters_dir:
@@ -436,10 +467,14 @@ class InferenceEngine:
         torch.cuda.mem_get_info, inference_api.py)."""
         dev = jax.devices()[0]
         bpt = self.md.kv_bytes_per_token(jnp.dtype(self.cfg.kv_dtype).itemsize)
+        # sizing runs AFTER params are resident (and quantized), so the
+        # ACTUAL weight bytes are known — no dtype/quant estimation
+        weights = sum(x.nbytes for x in jax.tree.leaves(self.params))
         try:
             stats = dev.memory_stats()
             limit = stats["bytes_limit"] * HBM_UTILIZATION
-            free = limit - stats["bytes_in_use"]
+            # bytes_in_use already includes the resident weights
+            free = limit - stats["bytes_in_use"] - PER_CHIP_OVERHEAD_BYTES
         except Exception:
             if dev.platform == "cpu":
                 # host RAM: enough for max_num_seqs full contexts
@@ -450,9 +485,7 @@ class InferenceEngine:
             # cap OOMed a 16 GiB v5e at 7 GiB of weights
             limit = float(os.environ.get(
                 "KAITO_HBM_BYTES", 16 * 1024 ** 3)) * HBM_UTILIZATION
-            free = limit
-        weights = self.md.arch.param_count() * self.dtype.itemsize
-        free = free - weights - PER_CHIP_OVERHEAD_BYTES
+            free = limit - weights - PER_CHIP_OVERHEAD_BYTES
         pages = int(max(free, 0) // (bpt * self.cfg.page_size))
         cap = self.cfg.max_num_seqs * self.pages_per_seq
         return max(2, min(pages, cap) + 1)
@@ -714,9 +747,12 @@ class InferenceEngine:
             self.allocator.release(slot.pages)
         # reset the sampling row to greedy/no-mask: the sampler's
         # sort-skip and draw-skip gates read EVERY row, so one retired
-        # top-p request would otherwise defeat them forever
-        self.sampling = self.sampling.set_slot(
-            slot_idx, temperature=0.0, top_k=0, top_p=1.0, seed=0)
+        # top-p request would otherwise defeat them forever.  Greedy
+        # rows are already in the reset state — skip the device updates
+        # on the (common) greedy-traffic path.
+        sp = req.params
+        if sp.temperature > 0.0 or sp.top_k > 0 or sp.top_p < 1.0:
+            self.sampling = self.sampling.reset_slot(slot_idx)
         slot.request = None
         slot.pages = []
         slot.prefilling = False

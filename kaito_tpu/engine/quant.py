@@ -1,0 +1,61 @@
+"""Weight-only int8 quantization for serving.
+
+Per-out-channel symmetric int8: each matmul weight ``[.., in, out]``
+becomes ``{"q8": int8, "scale": f32[.., out]}``; ``nn.linear`` dequants
+on use, so under jit the int8 stays in HBM and the dequant fuses into
+the dot.  Decode is parameter-bandwidth-bound on TPU, so halving the
+weight bytes is a direct throughput lever — the serving counterpart of
+the quantized presets the reference runs through vLLM
+(``--quantization`` in inference_api.py; preset quant methods in
+presets/workspace/generator/generator.go).
+
+Scope (round 2): the dense GQA families.  Attention q/k/v/o and MLP
+gate/up/down quantize; embeddings, norms, biases, and the (often tied)
+lm_head stay bf16 — the logits matmul is quality-critical and the
+embedding gather needs the full-precision table anyway.  MLA and MoE
+presets are rejected for now (their projections bypass nn.linear).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from kaito_tpu.models.metadata import AttentionKind, ModelArch
+
+# layer-stack keys that flow through nn.linear and are safe to quantize
+QUANT_KEYS = ("q", "k", "v", "o", "gate", "up", "down")
+
+
+def supports_quantization(arch: ModelArch) -> bool:
+    return arch.attention_kind != AttentionKind.MLA and arch.num_experts == 0
+
+
+def quantize_weight(w: jax.Array) -> dict:
+    """[.., in, out] bf16/f32 -> {"q8": int8, "scale": f32[.., out]}."""
+    scale = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2) / 127.0
+    scale = jnp.maximum(scale, 1e-8)
+    q8 = jnp.round(w.astype(jnp.float32) / scale[..., None, :])
+    q8 = jnp.clip(q8, -127, 127).astype(jnp.int8)
+    return {"q8": q8, "scale": scale}
+
+
+def quantize_params(params: dict, arch: ModelArch) -> dict:
+    """Quantize a serving param tree in place-shape (new tree).
+
+    Stacked layer weights ``[L, in, out]`` get per-(layer, out-channel)
+    scales.  Non-matmul leaves pass through untouched.
+    """
+    if not supports_quantization(arch):
+        raise ValueError(
+            "int8 serving currently covers dense GQA families only "
+            f"(MLA or MoE layers present)")
+    out = dict(params)
+    for group in ("dense",):
+        stack = dict(params[group])
+        for key in QUANT_KEYS:
+            if key in stack:
+                stack[key] = quantize_weight(stack[key])
+        out[group] = stack
+    return out
+

@@ -37,6 +37,16 @@ class SamplingState:
             key=jnp.asarray(keys, jnp.uint32),
         )
 
+    def reset_slot(self, i: int) -> "SamplingState":
+        """Greedy/no-mask row without touching the PRNG key (admission
+        reseeds it): keeps retirement to three tiny scatters."""
+        return SamplingState(
+            temperature=self.temperature.at[i].set(0.0),
+            top_k=self.top_k.at[i].set(0),
+            top_p=self.top_p.at[i].set(1.0),
+            key=self.key,
+        )
+
     def set_slot(self, i: int, *, temperature: float, top_k: int, top_p: float,
                  seed: int) -> "SamplingState":
         key = jax.random.fold_in(jax.random.PRNGKey(seed), i)

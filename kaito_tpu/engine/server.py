@@ -458,6 +458,8 @@ def main(argv=None):
                     default=int(os.environ.get("KAITO_EXPERT_PARALLEL", "1")))
     ap.add_argument("--served-model-name", default="")
     ap.add_argument("--dtype", default="")
+    ap.add_argument("--quantization", default=os.environ.get(
+        "KAITO_QUANTIZATION", ""), choices=["", "int8"])
     ap.add_argument("--kaito-config-file", default="")
     ap.add_argument("--kaito-adapters-dir", default="")
     ap.add_argument("--weights-dir",
@@ -496,6 +498,7 @@ def main(argv=None):
         kv_dtype=args.dtype or ("bfloat16" if on_tpu else "float32"),
         adapters_dir=args.kaito_adapters_dir,
         weights_dir=args.weights_dir,
+        quantization=args.quantization,
         pd_enabled=args.pd_enabled,
         pd_source_allowlist=args.pd_source_allowlist,
         disable_rate_limit=args.kaito_disable_rate_limit,
