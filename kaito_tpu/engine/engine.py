@@ -170,16 +170,23 @@ class InferenceEngine:
             self.model.moe_impl = ("dense" if cfg.expert_parallel > 1
                                    else "ragged")
         self.tokenizer = load_tokenizer(self.md.hf_id, arch.vocab_size)
-        self.mesh = mesh if mesh is not None else self._build_mesh()
         self.pp_exec = None
         if cfg.pipeline_parallel > 1:
-            if cfg.tensor_parallel > 1 or cfg.expert_parallel > 1:
-                raise ValueError("pipeline_parallel composes with "
-                                 "tensor/expert parallelism in a later round")
+            if cfg.expert_parallel > 1:
+                raise ValueError("pipeline_parallel does not compose with "
+                                 "expert parallelism (MoE models are "
+                                 "served TP x EP)")
             if cfg.pd_enabled:
                 raise ValueError("P/D disaggregation is not supported with "
                                  "pipeline-parallel serving")
+            if mesh is not None:
+                raise ValueError("pipeline-parallel serving builds its own "
+                                 "(pipeline, tensor) mesh; an explicit mesh "
+                                 "cannot be honored")
+            self.mesh = None       # the PP executor owns the full mesh
             self.pp_exec = self._build_pp_executor()
+        else:
+            self.mesh = mesh if mesh is not None else self._build_mesh()
 
         if not cfg.max_model_len:
             cfg.max_model_len = min(self.md.max_model_len, 8192)
@@ -264,7 +271,7 @@ class InferenceEngine:
             self.params = self.pp_exec.stage_params(self.params)
         self.prefix_cache = None
         if cfg.enable_prefix_caching and not self.model.is_mla \
-                and self.mesh is None:
+                and self.mesh is None and self.pp_exec is None:
             try:
                 from kaito_tpu.native import NativePrefixCache
 
@@ -368,17 +375,24 @@ class InferenceEngine:
 
     def _build_pp_executor(self):
         """Stage-sharded serving executor over the planner's pipeline
-        axis (tier 3; reference interface.go:519-530)."""
+        axis, with TP composing inside each stage — the reference's
+        tier 3 (TP-within-node x PP-across-nodes,
+        interface.go:514-560)."""
         from jax.sharding import Mesh
 
         from kaito_tpu.parallel.pp_serve import PipelineServeExecutor
 
         pp = self.cfg.pipeline_parallel
+        tp = max(1, self.cfg.tensor_parallel)
         devices = jax.devices()
-        if len(devices) < pp:
-            raise ValueError(f"pipeline_parallel={pp} but only "
-                             f"{len(devices)} devices visible")
-        mesh = Mesh(np.array(devices[:pp]), ("pipeline",))
+        if len(devices) < pp * tp:
+            raise ValueError(f"pipeline_parallel={pp} x tensor_parallel="
+                             f"{tp} but only {len(devices)} devices visible")
+        if tp > 1:
+            mesh = Mesh(np.array(devices[:pp * tp]).reshape(pp, tp),
+                        ("pipeline", "tensor"))
+        else:
+            mesh = Mesh(np.array(devices[:pp]), ("pipeline",))
         if self.cfg.pp_microbatches < 1:
             raise ValueError(f"pp_microbatches must be >= 1, got "
                              f"{self.cfg.pp_microbatches}")

@@ -37,8 +37,10 @@ def split_stage_params(model: TransformerLM, params: dict, num_stages: int) -> d
         raise ValueError(f"{L} layers do not split into {num_stages} stages")
     out = dict(params)
     out[group.name] = {
-        k: v.reshape((num_stages, L // num_stages) + v.shape[1:])
-        for k, v in params[group.name].items()}
+        k: jax.tree.map(
+            lambda v: v.reshape((num_stages, L // num_stages) + v.shape[1:]),
+            sub)
+        for k, sub in params[group.name].items()}
     return out
 
 
@@ -46,7 +48,8 @@ def merge_stage_params(model: TransformerLM, params: dict) -> dict:
     (group,) = model.groups
     out = dict(params)
     out[group.name] = {
-        k: v.reshape((-1,) + v.shape[2:]) for k, v in params[group.name].items()}
+        k: jax.tree.map(lambda v: v.reshape((-1,) + v.shape[2:]), sub)
+        for k, sub in params[group.name].items()}
     return out
 
 

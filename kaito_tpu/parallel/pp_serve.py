@@ -17,9 +17,17 @@ at steady state every stage computes a different microbatch.  Prefill
 flows one request through the ring (a single-request prefill is
 inherently sequential; stages overlap across *ticks* instead).
 
-Scope (v1): dense single-group models (no MoE/MLA), global attention
-(no sliding-window scan flags), pipeline-only mesh — the tensor axis
-composes inside stages in a later round.
+TP composes *inside* each stage (the reference's tier 3 is exactly
+TP-within-node × PP-across-nodes, interface.go:514-530): the mesh
+carries a ``tensor`` axis alongside ``pipeline``, the staged weights
+keep their Megatron shardings (SERVE_RULES) on that axis, and the
+shard_map is *partial-manual* — only the pipeline axis is manual
+(explicit ``ppermute`` ring); the tensor axis stays auto, so GSPMD
+inserts the TP collectives inside each stage exactly as it does for
+the flat-TP engine.
+
+Scope: dense single-group models (no MoE/MLA), global attention
+(no sliding-window scan flags).
 """
 
 from __future__ import annotations
@@ -52,6 +60,7 @@ class PipelineServeExecutor:
         self.mesh = mesh
         self.axis = axis
         self.num_stages = mesh.shape[axis]
+        self.tp = int(mesh.shape.get("tensor", 1))
         (self.group,) = model.groups
         if model.arch.num_layers % self.num_stages:
             raise ValueError(f"{model.arch.num_layers} layers do not split "
@@ -63,6 +72,10 @@ class PipelineServeExecutor:
     # ------------------------------------------------------------------
 
     def _param_specs(self, staged_params: dict) -> dict:
+        """shard_map in_specs: MANUAL axes only.  The stage dim of each
+        layer stack is manual over the pipeline axis; everything else is
+        unconstrained here — tensor sharding rides the arrays' own
+        placements through the auto axis."""
         gname = self.group.name
         return {
             k: (jax.tree.map(lambda _: P(self.axis), v)
@@ -70,21 +83,61 @@ class PipelineServeExecutor:
             for k, v in staged_params.items()
         }
 
+    def _placement_shardings(self, staged_params: dict) -> dict:
+        """device_put shardings: pipeline on the stage dim AND the
+        Megatron tensor axes from SERVE_RULES on the weight dims, so the
+        auto (GSPMD) side of the partial-manual shard_map sees the same
+        TP layout the flat-TP engine uses."""
+        from kaito_tpu.parallel.sharding import SERVE_RULES
+
+        gname = self.group.name
+        axes = self.model.param_logical_axes()
+
+        def leaf(ax, prefix=()):
+            if self.tp <= 1:
+                return NamedSharding(
+                    self.mesh, P(*prefix) if prefix else P())
+            return NamedSharding(
+                self.mesh, P(*prefix, *tuple(SERVE_RULES.spec(ax))))
+
+        def entry(name, v, ax_tree, prefix=()):
+            ax = ax_tree[name]
+            if isinstance(v, dict):     # QTensor {"q8", "scale"}
+                return {"q8": leaf(ax, prefix),
+                        "scale": leaf(ax[:-2] + ax[-1:], prefix)}
+            return leaf(ax, prefix)
+
+        out = {}
+        for k, v in staged_params.items():
+            if k == gname:
+                out[k] = {name: entry(name, sub, axes[gname],
+                                      prefix=(self.axis,))
+                          for name, sub in v.items()}
+            elif k in axes:
+                out[k] = entry(k, v, axes)
+            else:
+                out[k] = jax.tree.map(
+                    lambda _: NamedSharding(self.mesh, P()), v)
+        return out
+
     def stage_params(self, params: dict) -> dict:
         """[L, ...] layer stacks -> [S, L/S, ...] sharded over the
-        pipeline axis; top-level params replicate."""
+        pipeline axis (and the tensor axis per SERVE_RULES); top-level
+        params keep their TP sharding and replicate over pipeline."""
         staged = split_stage_params(self.model, params, self.num_stages)
-        specs = self._param_specs(staged)
-        shardings = jax.tree.map(
-            lambda s: NamedSharding(self.mesh, s), specs,
-            is_leaf=lambda x: isinstance(x, P))
-        return jax.device_put(staged, shardings)
+        return jax.device_put(staged, self._placement_shardings(staged))
 
     def stage_cache(self, cache: KVCache) -> KVCache:
         """[L, pages, ps, H, D] -> [S, L/S, pages, ps, H, D] sharded over
-        the pipeline axis (each stage owns its layers' KV)."""
+        the pipeline axis (each stage owns its layers' KV), with the
+        kv-head dim on tensor when it divides (same rule as the flat-TP
+        engine's _cache_sharding)."""
         S = self.num_stages
-        sh = NamedSharding(self.mesh, P(self.axis))
+        spec = [self.axis, None, None, None, None, None]
+        if self.tp > 1 and self.model.arch.kv_cache_heads > 1 \
+                and self.model.arch.kv_cache_heads % self.tp == 0:
+            spec[4] = "tensor"
+        sh = NamedSharding(self.mesh, P(*spec))
 
         def split(a):
             return jax.device_put(
@@ -173,7 +226,7 @@ class PipelineServeExecutor:
                     local_decode, mesh=self.mesh,
                     in_specs=(specs, P(ax), P(ax), P(), P(), P(), P()),
                     out_specs=(P(ax), P(ax), P()),
-                    check_vma=False)
+                    axis_names={ax}, check_vma=False)
             k, v, logits = sharded(params, cache.k, cache.v, tokens,
                                    positions, page_tables, active)
             return KVCache(k=k, v=v), logits
@@ -249,7 +302,7 @@ class PipelineServeExecutor:
                     local_prefill, mesh=self.mesh,
                     in_specs=(specs, P(ax), P(ax), P(), P(), P(), P()),
                     out_specs=(P(ax), P(ax), P()),
-                    check_vma=False)
+                    axis_names={ax}, check_vma=False)
             if start_pos is None:
                 start_pos = jnp.zeros((tokens.shape[0],), jnp.int32)
             k, v, logits = sharded(params, cache.k, cache.v, tokens,

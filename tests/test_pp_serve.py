@@ -60,10 +60,46 @@ def test_pp_chunked_prefill_parity():
     assert out == ref
 
 
+def test_pp_tp_decode_greedy_parity():
+    """The north-star serving shape: TP inside each pipeline stage
+    (reference tier 3, interface.go:514-530).  pp=2 x tp=2 over 4 CPU
+    devices must decode greedily identically to a single device."""
+    ref_eng = InferenceEngine(EngineConfig(**BASE))
+    eng = InferenceEngine(
+        EngineConfig(**{**BASE, "pipeline_parallel": 2,
+                        "tensor_parallel": 2, "pp_microbatches": 2}))
+    assert eng.pp_exec is not None and eng.pp_exec.tp == 2
+    prompts = [[7, 8, 9], [11, 12, 13, 14], [21, 22], [5, 6, 7, 8, 9]]
+    ref_eng.start(); eng.start()
+    try:
+        refs = [list(ref_eng.submit(p, _greedy(8)).stream()) for p in prompts]
+        reqs = [eng.submit(p, _greedy(8)) for p in prompts]
+        outs = [list(r.stream()) for r in reqs]
+    finally:
+        ref_eng.stop(); eng.stop()
+    assert outs == refs
+
+
+def test_pp_tp_chunked_prefill_parity():
+    """Long prompt through the staged chunked-prefill path at pp=2xtp=2."""
+    ref_eng = InferenceEngine(EngineConfig(**BASE, max_prefill_tokens=32))
+    eng = InferenceEngine(
+        EngineConfig(**{**BASE, "pipeline_parallel": 2, "tensor_parallel": 2,
+                        "pp_microbatches": 2}, max_prefill_tokens=32))
+    prompt = [(13 * i) % 1800 + 2 for i in range(100)]
+    ref_eng.start(); eng.start()
+    try:
+        ref = list(ref_eng.submit(prompt, _greedy(6)).stream())
+        out = list(eng.submit(prompt, _greedy(6)).stream())
+    finally:
+        ref_eng.stop(); eng.stop()
+    assert out == ref
+
+
 def test_pp_guards():
-    with pytest.raises(ValueError, match="tensor/expert"):
+    with pytest.raises(ValueError, match="expert"):
         InferenceEngine(EngineConfig(**{**BASE, "pipeline_parallel": 2,
-                                        "tensor_parallel": 2}))
+                                        "expert_parallel": 2}))
     with pytest.raises(ValueError, match="P/D"):
         InferenceEngine(EngineConfig(**{**BASE, "pipeline_parallel": 2,
                                         "pd_enabled": True}))
