@@ -199,10 +199,6 @@ class InferenceEngine:
             # fail fast BEFORE any allocation or weight loading
             if cfg.quantization != "int8":
                 raise ValueError(f"unknown quantization {cfg.quantization!r}")
-            if self.mesh is not None or self.pp_exec is not None:
-                raise ValueError(
-                    "int8 serving is single-chip this round (TP/PP shard "
-                    "rules for QTensor trees land with the next pass)")
             from kaito_tpu.engine.quant import supports_quantization
 
             if not supports_quantization(arch):
@@ -219,9 +215,15 @@ class InferenceEngine:
             from kaito_tpu.engine.quant import quantize_params
 
             t0 = time.monotonic()
+            # under a TP mesh the QTensor tree gets explicit shardings
+            # derived from SERVE_RULES (q8 keeps the weight's spec, the
+            # per-out-channel scale keeps the out dim's); otherwise XLA
+            # would be free to re-lay-out the donated tree
+            qkw = ({"out_shardings": self._quantized_param_shardings()}
+                   if self.mesh is not None else {})
             self.params = jax.jit(
                 partial(quantize_params, arch=self.md.arch),
-                donate_argnums=0)(self.params)
+                donate_argnums=0, **qkw)(self.params)
             jax.block_until_ready(self.params)
             logger.info(
                 "int8 weights ready in %.1fs (%.2f GiB)",
@@ -238,14 +240,14 @@ class InferenceEngine:
         self.adapter_index: dict[str, int] = {}
         self.adapters_merged = False
         if cfg.adapters_dir:
-            if self.mesh is not None or self.pp_exec is not None:
-                # stacked per-request buffers are single-chip this round;
-                # TP/PP keep the round-1 merge-into-base semantics
+            if self.pp_exec is not None:
+                # stacked buffers would need stage-splitting alongside
+                # the layer stacks; PP keeps merge-into-base semantics
                 from kaito_tpu.engine.adapters import apply_adapters_to_params
 
-                logger.warning("TP/PP engine: adapters merge into base "
-                               "weights (per-request routing is "
-                               "single-chip this round)")
+                logger.warning("PP engine: adapters merge into base "
+                               "weights (per-request routing covers "
+                               "single-chip and TP engines)")
                 self.params = apply_adapters_to_params(
                     self.model, self.params, cfg.adapters_dir)
                 self.adapters_merged = True
@@ -259,6 +261,14 @@ class InferenceEngine:
                 serve_lora, self.adapter_index = load_adapter_stacks(
                     self.model, cfg.adapters_dir, self.md.name)
                 if serve_lora:
+                    if self.mesh is not None:
+                        # adapter factors are tiny; replicate across the
+                        # TP mesh so the scan body sees local buffers
+                        from jax.sharding import NamedSharding
+                        from jax.sharding import PartitionSpec as P
+
+                        serve_lora = jax.device_put(
+                            serve_lora, NamedSharding(self.mesh, P()))
                     self.params = {**self.params, "serve_lora": serve_lora}
                 elif discover_adapters(cfg.adapters_dir):
                     # MLA or no routable targets: keep the round-1
@@ -270,8 +280,11 @@ class InferenceEngine:
         if self.pp_exec is not None:
             self.params = self.pp_exec.stage_params(self.params)
         self.prefix_cache = None
-        if cfg.enable_prefix_caching and not self.model.is_mla \
-                and self.mesh is None and self.pp_exec is None:
+        if cfg.enable_prefix_caching and not self.model.is_mla:
+            # the radix tree tracks host-side PAGE IDS only — the same
+            # ids index the sharded (TP) or stage-split (PP) pools, so
+            # prefix reuse is layout-independent and works under any
+            # mesh (lifting the round-2 single-chip gate)
             try:
                 from kaito_tpu.native import NativePrefixCache
 
@@ -287,10 +300,13 @@ class InferenceEngine:
         self._capacity_tokens = (num_pages - 1) * cfg.page_size
         self.host_kv = None
         if cfg.host_kv_offload_bytes > 0:
-            if self.mesh is not None or self.pp_exec is not None:
+            if self.pp_exec is not None:
+                # the stage-split [S, L/S, pages, ...] layout moves the
+                # page dim; PP keeps the preempt-recompute fallback
                 logger.warning(
-                    "host KV offload is single-chip only in this round; "
-                    "TP/PP engines fall back to preempt-recompute")
+                    "host KV offload does not cover pipeline-parallel "
+                    "cache layouts; PP engines fall back to "
+                    "preempt-recompute")
             else:
                 from kaito_tpu.engine.host_offload import HostKVPool
 
@@ -427,6 +443,31 @@ class InferenceEngine:
         return jax.tree.map(
             lambda ax: NamedSharding(self.mesh, SERVE_RULES.spec(ax)),
             axes, is_leaf=lambda x: isinstance(x, tuple))
+
+    def _quantized_param_shardings(self):
+        """Shardings for the post-quantization tree: q8 keeps its
+        weight's SERVE_RULES spec; the per-out-channel scale drops the
+        contracted (in) dim and keeps the out dim's assignment."""
+        from jax.sharding import NamedSharding
+
+        from kaito_tpu.engine.quant import is_quantized_leaf, \
+            qtensor_logical_axes
+        from kaito_tpu.parallel.sharding import SERVE_RULES
+
+        def sh(ax):
+            return NamedSharding(self.mesh, SERVE_RULES.spec(ax))
+
+        out: dict = {}
+        for k, v in self.model.param_logical_axes().items():
+            if isinstance(v, dict):
+                out[k] = {
+                    n: (jax.tree.map(sh, qtensor_logical_axes(ax),
+                                     is_leaf=lambda x: isinstance(x, tuple))
+                        if is_quantized_leaf(k, n) else sh(ax))
+                    for n, ax in v.items()}
+            else:
+                out[k] = sh(v)
+        return out
 
     def _cache_sharding(self):
         from jax.sharding import NamedSharding
@@ -1151,15 +1192,23 @@ class InferenceEngine:
         if len(slot.pages) < n_pages \
                 or entry.written != len(req.resume_tokens()) - 1:
             return False    # stale entry: fall back to recompute
-        from kaito_tpu.engine.host_offload import scatter_pages
-
         # mirror the spill's power-of-two padding; pad slots target the
         # null page, whose content is garbage by design
         bucket = entry.k.shape[1]
         ids = np.zeros((bucket,), np.int32)
         ids[:n_pages] = slot.pages[:n_pages]
-        k, v = scatter_pages(self.cache.k, self.cache.v,
-                             jnp.asarray(ids), entry.k, entry.v)
+        ids, ek, ev = jnp.asarray(ids), entry.k, entry.v
+        if self.mesh is not None:
+            # host-pool entries are committed to the host device; the
+            # pool spans the mesh — replicate the operands first so the
+            # jitted scatter sees one consistent device set
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            repl = NamedSharding(self.mesh, P())
+            ids, ek, ev = (jax.device_put(x, repl) for x in (ids, ek, ev))
+        k, v = self._scatter_pages_fn()(self.cache.k, self.cache.v,
+                                        ids, ek, ev)
         self.cache = KVCache(k=k, v=v)
         self.counters["host_kv_restored_pages_total"] += n_pages
         n = len(req.resume_tokens())
@@ -1178,6 +1227,22 @@ class InferenceEngine:
         logger.debug("restored %s: %d pages, resuming at %d",
                      req.req_id, n_pages, entry.written)
         return True
+
+    def _scatter_pages_fn(self):
+        """Jitted restore-scatter; under a TP mesh the donated pool is
+        pinned to its original sharding so restores never re-lay-out
+        the cache (which would recompile every decode program)."""
+        fn = getattr(self, "_scatter_jit", None)
+        if fn is None:
+            from kaito_tpu.engine.host_offload import _scatter_impl
+
+            kw = {}
+            if self.mesh is not None:
+                sh = self._cache_sharding()
+                kw["out_shardings"] = (sh, sh)
+            fn = jax.jit(_scatter_impl, donate_argnums=(0, 1), **kw)
+            self._scatter_jit = fn
+        return fn
 
     def _newest_slot(self) -> Optional[int]:
         candidates = [i for i, s in enumerate(self.slots)

@@ -12,9 +12,10 @@ so D2H overlaps decode), and re-admission scatters them back into
 freshly acquired pages instead of recomputing the whole prefix.
 
 Dropping an entry is always safe: resume falls back to the recompute
-path the scheduler already has.  v1 scope: single-chip engines (no
-TP/PP cache layouts); the multi-chip spill follows the same page-id
-contract later.
+path the scheduler already has.  Covers single-chip and TP engines
+(the gather/scatter page-id contract is layout-independent; the TP
+engine pins the restored pool's sharding via out_shardings); the PP
+stage-split layout keeps the recompute fallback.
 """
 
 from __future__ import annotations
@@ -102,8 +103,12 @@ def gather_pages(cache_k, cache_v, ids):
     return cache_k[:, ids], cache_v[:, ids]
 
 
-@partial(jax.jit, donate_argnums=(0, 1))
-def scatter_pages(cache_k, cache_v, ids, k_pages, v_pages):
-    """Write spilled pages back into freshly acquired page slots."""
+def _scatter_impl(cache_k, cache_v, ids, k_pages, v_pages):
+    """Write spilled pages back into freshly acquired page slots.
+    (Unjitted body: TP engines jit it with explicit out_shardings so
+    the donated pool keeps its head-dim sharding across restores.)"""
     return (cache_k.at[:, ids].set(k_pages.astype(cache_k.dtype)),
             cache_v.at[:, ids].set(v_pages.astype(cache_v.dtype)))
+
+
+scatter_pages = partial(jax.jit, donate_argnums=(0, 1))(_scatter_impl)

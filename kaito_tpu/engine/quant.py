@@ -26,6 +26,21 @@ from kaito_tpu.models.metadata import AttentionKind, ModelArch
 # layer-stack keys that flow through nn.linear and are safe to quantize
 QUANT_KEYS = ("q", "k", "v", "o", "gate", "up", "down")
 
+# the group quantize_params touches (dense GQA families only)
+QUANT_GROUP = "dense"
+
+
+def is_quantized_leaf(group: str, name: str) -> bool:
+    """Whether quantize_params turns params[group][name] into a QTensor."""
+    return group == QUANT_GROUP and name in QUANT_KEYS
+
+
+def qtensor_logical_axes(ax: tuple) -> dict:
+    """Logical axes for the QTensor pair produced from a weight whose
+    axes are ``ax``: q8 keeps the weight's axes; the per-out-channel
+    scale drops the contracted (in, = second-to-last) dim."""
+    return {"q8": ax, "scale": ax[:-2] + ax[-1:]}
+
 
 def supports_quantization(arch: ModelArch) -> bool:
     return arch.attention_kind != AttentionKind.MLA and arch.num_experts == 0

@@ -469,6 +469,12 @@ def main(argv=None):
     ap.add_argument("--pd-source-allowlist",
                     default=os.environ.get("KAITO_PD_ALLOWLIST", ""))
     ap.add_argument("--kaito-disable-rate-limit", action="store_true")
+    ap.add_argument("--enable-prefix-caching", dest="enable_prefix_caching",
+                    action="store_true", default=True,
+                    help="native radix-tree prefix reuse (default on; "
+                         "vLLM flag-name parity)")
+    ap.add_argument("--no-enable-prefix-caching", dest="enable_prefix_caching",
+                    action="store_false")
     ap.add_argument("--kaito-kv-cache-cpu-memory-utilization", type=float,
                     default=float(os.environ.get(
                         "KAITO_KV_CPU_MEM_UTIL", "0")),
@@ -502,6 +508,7 @@ def main(argv=None):
         pd_enabled=args.pd_enabled,
         pd_source_allowlist=args.pd_source_allowlist,
         disable_rate_limit=args.kaito_disable_rate_limit,
+        enable_prefix_caching=args.enable_prefix_caching,
         host_kv_offload_bytes=int(
             args.kaito_kv_cache_cpu_memory_utilization
             * os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES")),

@@ -101,10 +101,12 @@ class PipelineServeExecutor:
                 self.mesh, P(*prefix, *tuple(SERVE_RULES.spec(ax))))
 
         def entry(name, v, ax_tree, prefix=()):
+            from kaito_tpu.engine.quant import qtensor_logical_axes
+
             ax = ax_tree[name]
             if isinstance(v, dict):     # QTensor {"q8", "scale"}
-                return {"q8": leaf(ax, prefix),
-                        "scale": leaf(ax[:-2] + ax[-1:], prefix)}
+                return {kk: leaf(aa, prefix)
+                        for kk, aa in qtensor_logical_axes(ax).items()}
             return leaf(ax, prefix)
 
         out = {}
