@@ -43,6 +43,11 @@ class EngineConfig:
     # runs K steps with on-device sampling + stop detection, amortizing
     # the per-step host round-trip.  None = auto (8 on TPU, 1 elsewhere)
     decode_run_ahead: Optional[int] = None
+    # fused decode steps per dispatch while requests are waiting or
+    # prefilling (the sustained-admission regime).  Smaller than
+    # decode_run_ahead so admissions and prefill chunks keep a bounded
+    # latency; 0 restores the round-2 collapse-to-single-step behavior
+    fused_under_load: int = 4
     # serving-side knobs carried over from the reference wrapper surface
     port: int = 5000
     served_model_name: str = ""

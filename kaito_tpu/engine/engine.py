@@ -210,25 +210,34 @@ class InferenceEngine:
         # weight bytes (post-quantization), and quantizing with a
         # donated tree frees the bf16 weights before the pool claims
         # the rest of HBM
-        self.params = params if params is not None else self._init_params()
-        if cfg.quantization:
-            from kaito_tpu.engine.quant import quantize_params
+        if cfg.quantization and params is None and not cfg.weights_dir:
+            # synthetic weights: FUSE init+quantize in one jit so XLA's
+            # memory planner frees each bf16 leaf right after its
+            # quantize — an 8B-class bf16 tree (16 GiB) never has to be
+            # resident at once on a 16 GiB chip
+            self.params = self._init_quantized_params()
+        else:
+            self.params = params if params is not None else self._init_params()
+            if cfg.quantization:
+                from kaito_tpu.engine.quant import quantize_params
 
-            t0 = time.monotonic()
-            # under a TP mesh the QTensor tree gets explicit shardings
-            # derived from SERVE_RULES (q8 keeps the weight's spec, the
-            # per-out-channel scale keeps the out dim's); otherwise XLA
-            # would be free to re-lay-out the donated tree
-            qkw = ({"out_shardings": self._quantized_param_shardings()}
-                   if self.mesh is not None else {})
-            self.params = jax.jit(
-                partial(quantize_params, arch=self.md.arch),
-                donate_argnums=0, **qkw)(self.params)
-            jax.block_until_ready(self.params)
-            logger.info(
-                "int8 weights ready in %.1fs (%.2f GiB)",
-                time.monotonic() - t0,
-                sum(x.nbytes for x in jax.tree.leaves(self.params)) / 2**30)
+                t0 = time.monotonic()
+                # under a TP mesh the QTensor tree gets explicit
+                # shardings derived from SERVE_RULES (q8 keeps the
+                # weight's spec, the per-out-channel scale keeps the
+                # out dim's); otherwise XLA would be free to re-lay-out
+                # the donated tree
+                qkw = ({"out_shardings": self._quantized_param_shardings()}
+                       if self.mesh is not None else {})
+                self.params = jax.jit(
+                    partial(quantize_params, arch=self.md.arch),
+                    donate_argnums=0, **qkw)(self.params)
+                jax.block_until_ready(self.params)
+                logger.info(
+                    "int8 weights ready in %.1fs (%.2f GiB)",
+                    time.monotonic() - t0,
+                    sum(x.nbytes for x in jax.tree.leaves(self.params))
+                    / 2**30)
 
         num_pages = cfg.max_pages or self._derive_max_pages()
         num_pages = max(num_pages, cfg.max_num_seqs * self.pages_per_seq // 4 + 2)
@@ -329,6 +338,7 @@ class InferenceEngine:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._tick = 0
+        self._decode_since_prefill = 0
         self._prefill_rr = 0
         self._admit_seq = 0
 
@@ -512,6 +522,32 @@ class InferenceEngine:
                     jax.random.PRNGKey(self.cfg.seed))
         jax.block_until_ready(params)
         logger.info("weights ready in %.1fs (%.2f GiB)",
+                    time.monotonic() - t0,
+                    sum(x.nbytes for x in jax.tree.leaves(params)) / 2**30)
+        return params
+
+    def _init_quantized_params(self):
+        """Synthetic weights, quantized inside the init jit (see
+        __init__: keeps peak HBM at int8-tree + one bf16 leaf)."""
+        from kaito_tpu.engine.quant import quantize_params
+
+        logger.info("initializing synthetic int8 weights for %s (mesh=%s)",
+                    self.md.name, self.mesh)
+        t0 = time.monotonic()
+
+        def init_q(key):
+            return quantize_params(self.model.init_params(key),
+                                   arch=self.md.arch)
+
+        if self.mesh is not None:
+            params = jax.jit(
+                init_q, out_shardings=self._quantized_param_shardings())(
+                    jax.random.PRNGKey(self.cfg.seed))
+        else:
+            with jax.default_device(jax.devices()[0]):
+                params = jax.jit(init_q)(jax.random.PRNGKey(self.cfg.seed))
+        jax.block_until_ready(params)
+        logger.info("int8 weights ready in %.1fs (%.2f GiB)",
                     time.monotonic() - t0,
                     sum(x.nbytes for x in jax.tree.leaves(params)) / 2**30)
         return params
@@ -746,6 +782,10 @@ class InferenceEngine:
         self._wake.set()
         if self._thread:
             self._thread.join(timeout=30)
+        # fail whatever is still in flight so no client blocks forever
+        # in Request.stream() after shutdown (the loop thread is gone;
+        # nothing else would ever deliver their end-of-stream sentinel)
+        self._fail_all()
 
     # ------------------------------------------------------------------
     # Scheduler loop
@@ -883,21 +923,34 @@ class InferenceEngine:
             self._ensure_decode_pages(la)
         did = self._admit_new()
         decoding = bool(self.active.any())
+        steps_run = 0
         if decoding:
-            # recheck the gate: ensure-pages may have preempted (queue
-            # non-empty now), and ANY admission — including KV-import /
-            # spill-restore slots that begin decoding immediately —
-            # post-dates the page-reservation pass, so its slots have no
-            # lookahead pages yet
-            if la > 1 and not did and self._decode_lookahead() == la:
-                self._decode_multi(la)
+            # recompute after admission: ensure-pages may have preempted
+            # (queue non-empty caps K at fused_under_load), and
+            # KV-import / spill-restore admissions begin decoding
+            # immediately — their slots post-date the reservation pass,
+            # so a fused dispatch must re-reserve lookahead pages first
+            la2 = self._decode_lookahead()
+            if la2 > 1:
+                if did or la2 > la:
+                    self._ensure_decode_pages(la2)
+                self._decode_multi(la2)
+                steps_run = la2
             else:
                 self._decode_once()
+                steps_run = 1
             did = True
         self._tick += 1
+        # prefill cadence counts DECODE STEPS, not scheduler iterations:
+        # a fused K-step dispatch advances the clock by K, so the
+        # decode:prefill token ratio stays prefill_interleave:1 whether
+        # or not fusion is engaged
+        self._decode_since_prefill += steps_run
         if (not decoding) or self.cfg.prefill_interleave <= 1 \
-                or self._tick % self.cfg.prefill_interleave == 0:
-            did = self._advance_prefills() or did
+                or self._decode_since_prefill >= self.cfg.prefill_interleave:
+            if self._advance_prefills():
+                did = True
+                self._decode_since_prefill = 0
         return did
 
     def _admit_new(self) -> bool:
@@ -1296,28 +1349,40 @@ class InferenceEngine:
             self.last_tokens[i] = int(toks[i])
 
     def _decode_lookahead(self) -> int:
-        """How many decode steps the next dispatch may fuse.  >1 only in
-        steady-state decode: nothing waiting, nothing prefilling, every
-        active slot's stop set fits the fixed device matrix, and no
-        abort is pending (aborts are host-side knowledge; the 1-step
-        path retires them promptly).  K is clamped to the batch's max
-        remaining budget (power-of-two bucketed, so at most
-        log2(run_ahead) compiled programs) and to what the free page
-        pool covers — speculative lookahead pages must never preempt a
-        running sequence."""
+        """How many decode steps the next dispatch may fuse.  Full
+        ``run_ahead`` in steady-state decode (nothing waiting, nothing
+        prefilling); capped at ``fused_under_load`` when requests are
+        waiting or prefilling, so fusion keeps amortizing dispatch
+        overhead in the sustained-admission regime — the normal serving
+        state — while admissions and prefill chunks still land every
+        few steps.  Always 1 when an abort is pending (host-side
+        knowledge; the 1-step path retires it promptly) or a slot's
+        stop set overflows the fixed device matrix.  K is clamped to
+        the batch's max remaining budget (power-of-two bucketed, so at
+        most log2(run_ahead) compiled programs) and to what the free
+        page pool covers — speculative lookahead pages must never
+        preempt a running sequence."""
         K = self.run_ahead
-        if K <= 1 or self.pp_exec is not None or self._waiting_count:
+        if K <= 1 or self.pp_exec is not None:
             return 1
+        busy = self._waiting_count > 0
         max_rem = 0
         for i, s in enumerate(self.slots):
             if s.request is None:
                 continue
-            if s.prefilling or s.request.aborted:
+            if s.request.aborted:
                 return 1
+            if s.prefilling:
+                busy = True
+                continue
             if self.active[i]:
                 if len(self._stop_set(s.request)) > _STOP_WIDTH:
                     return 1
                 max_rem = max(max_rem, s.remaining)
+        if busy:
+            K = min(K, self.cfg.fused_under_load)
+            if K <= 1:
+                return 1
         if max_rem < K:
             # every slot finishes within the window: shrink the scan so
             # it doesn't burn full-batch steps past the last real token

@@ -22,9 +22,7 @@ from __future__ import annotations
 
 import collections
 import logging
-import time
 from dataclasses import dataclass
-from functools import partial
 from typing import Optional
 
 import jax
@@ -105,10 +103,8 @@ def gather_pages(cache_k, cache_v, ids):
 
 def _scatter_impl(cache_k, cache_v, ids, k_pages, v_pages):
     """Write spilled pages back into freshly acquired page slots.
-    (Unjitted body: TP engines jit it with explicit out_shardings so
-    the donated pool keeps its head-dim sharding across restores.)"""
+    (Unjitted body: the engine jits it per-instance —
+    ``_scatter_pages_fn`` — with explicit out_shardings under a TP mesh
+    so the donated pool keeps its head-dim sharding across restores.)"""
     return (cache_k.at[:, ids].set(k_pages.astype(cache_k.dtype)),
             cache_v.at[:, ids].set(v_pages.astype(cache_v.dtype)))
-
-
-scatter_pages = partial(jax.jit, donate_argnums=(0, 1))(_scatter_impl)
