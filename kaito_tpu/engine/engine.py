@@ -555,8 +555,16 @@ class InferenceEngine:
     def _derive_max_pages(self) -> int:
         """Size the page pool from free HBM (the engine-side analogue of
         the reference's gpu-memory-utilization default computed from
-        torch.cuda.mem_get_info, inference_api.py)."""
-        dev = jax.devices()[0]
+        torch.cuda.mem_get_info, inference_api.py).  Sizing reads THIS
+        engine's own device: under in-engine DP, group N's pool must
+        budget against its own chips, not device 0's already-occupied
+        HBM."""
+        if self.mesh is not None:
+            dev = self.mesh.devices.flat[0]
+        elif self.pp_exec is not None:
+            dev = self.pp_exec.mesh.devices.flat[0]
+        else:
+            dev = jax.devices()[0]
         bpt = self.md.kv_bytes_per_token(jnp.dtype(self.cfg.kv_dtype).itemsize)
         # sizing runs AFTER params are resident (and quantized), so the
         # ACTUAL weight bytes are known — no dtype/quant estimation

@@ -456,6 +456,8 @@ def main(argv=None):
                     default=int(os.environ.get("KAITO_PIPELINE_PARALLEL", "1")))
     ap.add_argument("--expert-parallel-size", type=int,
                     default=int(os.environ.get("KAITO_EXPERT_PARALLEL", "1")))
+    ap.add_argument("--data-parallel-size", type=int,
+                    default=int(os.environ.get("KAITO_DATA_PARALLEL", "1")))
     ap.add_argument("--served-model-name", default="")
     ap.add_argument("--dtype", default="")
     ap.add_argument("--quantization", default=os.environ.get(
@@ -500,6 +502,7 @@ def main(argv=None):
         tensor_parallel=args.tensor_parallel_size,
         pipeline_parallel=args.pipeline_parallel_size,
         expert_parallel=args.expert_parallel_size,
+        data_parallel=args.data_parallel_size,
         dtype=args.dtype or ("bfloat16" if on_tpu else "float32"),
         kv_dtype=args.dtype or ("bfloat16" if on_tpu else "float32"),
         adapters_dir=args.kaito_adapters_dir,
@@ -522,12 +525,23 @@ def main(argv=None):
         # leader-only HTTP; workers follow the step broadcast headless
         from kaito_tpu.engine.multihost import MultiHostEngine
 
+        if cfg.data_parallel > 1:
+            raise ValueError("in-engine data_parallel is single-host; "
+                             "scale multi-host deployments with "
+                             "InferenceSet replicas")
         engine = MultiHostEngine(cfg)
         if not engine.is_leader:
             logger.info("worker process %d: joining lockstep loop",
                         jax.process_index())
             engine.run_worker()
             return
+        engine.start()
+    elif cfg.data_parallel > 1:
+        # reference tier 1: N engine groups on one node behind one
+        # HTTP front (interface.go:500-512 --data-parallel-size)
+        from kaito_tpu.engine.dp import DataParallelEngine
+
+        engine = DataParallelEngine(cfg)
         engine.start()
     else:
         engine = InferenceEngine(cfg)
