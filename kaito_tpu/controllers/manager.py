@@ -103,8 +103,23 @@ def main(argv=None):
                     help="use the in-process store even in-cluster (dev)")
     ap.add_argument("--namespace",
                     default=os.environ.get("POD_NAMESPACE", "default"))
+    ap.add_argument("--disable-preset-autogen", action="store_true",
+                    help="do not auto-generate presets for unregistered "
+                         "org/model ids (catalog + HF hub)")
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
+
+    # production preset auto-generation: unregistered org/model
+    # Workspaces resolve via the committed catalog, then the HF hub
+    # (reference: GeneratePreset at reconcile time,
+    # presets/workspace/generator/generator.go:805-830).  Wired at the
+    # entrypoint — not in Manager.__init__ — so embedding a Manager
+    # (tests, tools) never silently switches the process-global
+    # registry onto the network path.
+    if not args.disable_preset_autogen:
+        from kaito_tpu.models.hub import install_default_fetcher
+
+        install_default_fetcher()
 
     store = None
     in_cluster = "KUBERNETES_SERVICE_HOST" in os.environ

@@ -521,6 +521,13 @@ def main(argv=None):
         cfg = load_config_file(cfg, args.kaito_config_file)
 
     logging.basicConfig(level=logging.INFO)
+    if "/" in cfg.model:
+        # auto-generated presets render the FULL org/model id into
+        # --model; the pod resolves it the same way the controller did
+        # (committed catalog first, HF hub second)
+        from kaito_tpu.models.hub import install_default_fetcher
+
+        install_default_fetcher()
     if jax.process_count() > 1:
         # leader-only HTTP; workers follow the step broadcast headless
         from kaito_tpu.engine.multihost import MultiHostEngine

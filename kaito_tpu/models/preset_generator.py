@@ -1,0 +1,94 @@
+"""Preset generator CLI.
+
+The counterpart of the reference's ``cmd/preset-generator/main.go``
+(1-88): generate a preset for any HF model id and print the derived
+metadata the operator plans with — bytes/token, estimated file size,
+and the parallelism plan per TPU generation.
+
+Usage::
+
+    python -m kaito_tpu.models.preset_generator --model org/name
+    python -m kaito_tpu.models.preset_generator --model org/name \
+        --config-file recorded_config.json --chip v5e --json
+
+Resolution order: --config-file > committed catalog > HF hub (needs
+egress and, for gated models, HF_TOKEN).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from kaito_tpu.models.autogen import metadata_from_hf_config
+from kaito_tpu.models.hub import catalog_config, fetch_hf_config
+
+
+def generate(hf_id: str, cfg: dict):
+    md = metadata_from_hf_config(hf_id, cfg)
+    a = md.arch
+    out = {
+        "name": md.name,
+        "hf_id": md.hf_id,
+        "architecture": (cfg.get("architectures") or [""])[0],
+        "num_layers": a.num_layers,
+        "hidden_size": a.hidden_size,
+        "num_heads": a.num_heads,
+        "num_kv_heads": a.num_kv_heads,
+        "vocab_size": a.vocab_size,
+        "max_model_len": md.max_model_len,
+        "num_experts": a.num_experts,
+        "param_count": a.param_count(),
+        "kv_bytes_per_token_bf16": md.kv_bytes_per_token(2),
+        "model_file_bytes": md.file_bytes,
+    }
+    return md, out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="kaito-tpu-preset-generator")
+    ap.add_argument("--model", required=True, help="HF id (org/name)")
+    ap.add_argument("--config-file", default="",
+                    help="local recorded config.json (skips catalog/hub)")
+    ap.add_argument("--chip", default="v5e",
+                    help="TPU generation for the plan preview")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+
+    if args.config_file:
+        with open(args.config_file) as f:
+            cfg = json.load(f)
+    else:
+        cfg = catalog_config(args.model) or fetch_hf_config(args.model)
+    if cfg is None:
+        print(f"error: no config for {args.model} (not in the catalog; "
+              f"hub fetch failed or offline)", file=sys.stderr)
+        return 1
+
+    md, out = generate(args.model, cfg)
+
+    try:
+        from kaito_tpu.parallel.plan import plan_parallelism
+        from kaito_tpu.sku.catalog import CHIP_CATALOG
+
+        chip = CHIP_CATALOG[args.chip]
+        plan = plan_parallelism(md, chip)
+        out["plan"] = {"chip": args.chip, "topology": plan.topology,
+                       "num_slices": plan.num_slices,
+                       "mesh": str(plan.mesh),
+                       "notes": list(plan.notes)}
+    except Exception as e:
+        out["plan_error"] = str(e)
+
+    if args.json:
+        print(json.dumps(out, indent=2))
+    else:
+        for k, v in out.items():
+            print(f"{k:28s} {v}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

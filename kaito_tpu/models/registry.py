@@ -51,6 +51,13 @@ def get_model_by_name(name: str) -> ModelMetadata:
     ``vllm_model.go:116-160`` falls through to ``GeneratePreset``)."""
     with _lock:
         md = _registry.get(name)
+        if md is None and "/" in name:
+            # a Workspace may name the full HF id instead of the preset
+            # short name; registered presets win over auto-generation
+            # (their metadata carries curated file sizes/tags)
+            low = name.lower()
+            md = next((m for m in _registry.values()
+                       if m.hf_id.lower() == low), None)
     if md is not None:
         return md
     if _config_fetcher is not None and "/" in name:
@@ -58,7 +65,10 @@ def get_model_by_name(name: str) -> ModelMetadata:
         if cfg is not None:
             from kaito_tpu.models.autogen import metadata_from_hf_config
 
-            md = metadata_from_hf_config(name, cfg)
+            # register under the FULL id: a fork's basename must never
+            # clobber a curated preset sharing the short name (manifests
+            # and the engine both resolve the same full id)
+            md = metadata_from_hf_config(name, cfg, name=name)
             register_model(md, replace=True)
             return md
     raise KeyError(

@@ -1,0 +1,103 @@
+"""Production preset auto-generation: an unregistered ``org/model``
+Workspace reconciles to Ready using the committed catalog cache (the
+reference generates presets from the HF Hub at reconcile time,
+presets/workspace/generator/generator.go:805-830, and ships a
+precomputed catalog + preset-generator CLI)."""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from kaito_tpu.api import InferenceSpec, ObjectMeta, ResourceSpec, Workspace
+from kaito_tpu.api.meta import condition_true
+from kaito_tpu.api.workspace import COND_INFERENCE_READY
+from kaito_tpu.controllers.manager import Manager
+from kaito_tpu.controllers.runtime import Store
+from kaito_tpu.models import registry
+from kaito_tpu.models.hub import catalog_config, default_config_fetcher
+from kaito_tpu.provision import FakeCloud
+
+
+@pytest.fixture(autouse=True)
+def _reset_fetcher():
+    yield
+    registry.set_config_fetcher(None)
+
+
+def test_catalog_serves_recorded_configs_offline():
+    cfg = catalog_config("TinyLlama/TinyLlama-1.1B-Chat-v1.0")
+    assert cfg["num_hidden_layers"] == 22
+    # case-insensitive id match
+    assert catalog_config("tinyllama/tinyllama-1.1b-chat-v1.0") is not None
+    # the default fetcher serves catalog entries with zero egress
+    assert default_config_fetcher(
+        "Qwen/Qwen2.5-0.5B-Instruct")["hidden_size"] == 896
+
+
+def test_hf_id_resolves_registered_preset_without_fetcher():
+    """A Workspace naming the full HF id of a shipped preset must not
+    need any fetcher at all."""
+    md = registry.get_model_by_name("meta-llama/Llama-3.1-8B-Instruct")
+    assert md.name == "llama-3.1-8b-instruct"
+
+
+def test_unregistered_workspace_reconciles_from_catalog():
+    """End to end: with the production fetcher installed (manager
+    main() wiring), reconciling a Workspace that names a non-preset
+    org/model plans and deploys from the recorded catalog config."""
+    from kaito_tpu.models.hub import install_default_fetcher
+
+    install_default_fetcher()
+    store = Store()
+    mgr = Manager(store=store)
+    cloud = FakeCloud(store)
+    ws = Workspace(
+        ObjectMeta(name="tiny-hub"),
+        resource=ResourceSpec(instance_type="ct5lp-hightpu-1t"),
+        inference=InferenceSpec(preset="TinyLlama/TinyLlama-1.1B-Chat-v1.0"))
+    store.create(ws)
+    for _ in range(8):
+        mgr.workspace.reconcile_key("default", "tiny-hub")
+        cloud.tick()
+    ws = store.get("Workspace", "default", "tiny-hub")
+    assert condition_true(ws.status.conditions, COND_INFERENCE_READY), \
+        [c.__dict__ for c in ws.status.conditions]
+    ss = store.get("StatefulSet", "default", "tiny-hub")
+    cmd = " ".join(ss.spec["template"]["spec"]["containers"][0]["command"])
+    # the FULL id renders into --model so the pod resolves the same way
+    assert "TinyLlama/TinyLlama-1.1B-Chat-v1.0" in cmd
+
+
+def test_autogen_never_clobbers_curated_preset():
+    """A fork sharing a curated preset's basename must register under
+    its full id, leaving the shipped preset untouched."""
+    fork_cfg = dict(catalog_config("TinyLlama/TinyLlama-1.1B-Chat-v1.0"))
+    registry.set_config_fetcher(lambda hf_id: fork_cfg)
+    before = registry.get_model_by_name("llama-3.1-8b-instruct")
+    md = registry.get_model_by_name("some-fork/Llama-3.1-8B-Instruct")
+    assert md.name == "some-fork/Llama-3.1-8B-Instruct"
+    after = registry.get_model_by_name("llama-3.1-8b-instruct")
+    assert after is before               # curated preset untouched
+
+
+def test_preset_generator_cli_json():
+    out = subprocess.run(
+        [sys.executable, "-m", "kaito_tpu.models.preset_generator",
+         "--model", "Qwen/Qwen2.5-0.5B-Instruct", "--json"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    data = json.loads(out.stdout)
+    assert data["num_layers"] == 24
+    assert data["plan"]["mesh"].endswith("tensor:1")
+
+
+def test_preset_generator_cli_unknown_model_offline():
+    out = subprocess.run(
+        [sys.executable, "-m", "kaito_tpu.models.preset_generator",
+         "--model", "no-such-org/no-such-model"],
+        capture_output=True, text=True, timeout=120,
+        env={"PATH": "/usr/bin:/bin", "PYTHONPATH": ".",
+             "HF_HUB_OFFLINE": "1"})
+    assert out.returncode == 1
