@@ -4,8 +4,12 @@ Parity with the reference's guardrails subsystem
 (``presets/ragengine/guardrails/**``: llm-guard scanner pipeline with
 block/warn actions and streaming buffer-window scanning): a YAML policy
 file declares scanners; responses are scanned post-hoc or on a sliding
-window during streaming.  Scanners are dependency-free (keyword,
-regex, secrets/PII patterns, length) with the same action semantics.
+window during streaming.  Scanners are dependency-free with the same
+action semantics: every reference family has an analogue (secrets,
+PII, ban_substrings, regex, invisible_text, token_limit, json,
+reading_time) plus model-free analogues of llm-guard's model-based
+scanners (gibberish via character statistics, code via fence/keyword
+heuristics, ban_competitors via word-boundary matching).
 """
 
 from __future__ import annotations
@@ -141,6 +145,220 @@ class MaxLength(Scanner):
         return ScanResult(True, self.name)
 
 
+class TokenLimit(Scanner):
+    """Approximate-token budget (reference TokenLimitConfig: llm-guard
+    TokenLimit over tiktoken; here chars/4 — the standard byte-level
+    approximation — so the scanner stays dependency-free)."""
+
+    name = "token_limit"
+
+    def __init__(self, limit: int, chars_per_token: float = 4.0,
+                 action: str = "block"):
+        super().__init__(action)
+        self.limit = limit
+        self.cpt = chars_per_token
+
+    def scan(self, text: str) -> ScanResult:
+        approx = int(len(text) / self.cpt)
+        if approx > self.limit:
+            return ScanResult(False, self.name,
+                              f"~{approx} tokens > {self.limit}", self.action)
+        return ScanResult(True, self.name)
+
+
+# zero-width / bidi-control code points (llm-guard InvisibleText checks
+# unicodedata category Cf plus tags/variation selectors)
+_INVISIBLE = re.compile(
+    "[\u200b\u200c\u200d\u200e\u200f\u2060-\u2064"
+    "\u202a-\u202e\ufeff\U000e0000-\U000e007f\ufe00-\ufe0f]")
+
+
+class InvisibleText(Scanner):
+    name = "invisible_text"
+
+    def scan(self, text: str) -> ScanResult:
+        m = _INVISIBLE.search(text)
+        if m:
+            return ScanResult(False, self.name,
+                              f"invisible code point U+{ord(m.group()):04X}",
+                              self.action)
+        return ScanResult(True, self.name)
+
+
+class JSONScanner(Scanner):
+    """Require at least ``required`` well-formed JSON objects in the
+    output (fenced ```json blocks or bare braces), matching the
+    reference's JSONConfig semantics."""
+
+    name = "json"
+    _FENCE = re.compile(r"```(?:json)?\s*(\{.*?\}|\[.*?\])\s*```", re.S)
+    _BARE = re.compile(r"(\{.*\}|\[.*\])", re.S)
+
+    def __init__(self, required: int = 1, action: str = "block"):
+        super().__init__(action)
+        self.required = required
+
+    def scan(self, text: str) -> ScanResult:
+        import json as _json
+
+        valid = 0
+        candidates = self._FENCE.findall(text)
+        if not candidates:
+            m = self._BARE.search(text)
+            candidates = [m.group(1)] if m else []
+        for c in candidates:
+            try:
+                _json.loads(c)
+                valid += 1
+            except ValueError:
+                continue
+        if valid < self.required:
+            return ScanResult(False, self.name,
+                              f"{valid} valid JSON blocks < {self.required}",
+                              self.action)
+        return ScanResult(True, self.name)
+
+
+class ReadingTime(Scanner):
+    """Cap the response's reading time (reference ReadingTimeConfig;
+    240 wpm, llm-guard's default)."""
+
+    name = "reading_time"
+
+    def __init__(self, max_minutes: float, wpm: int = 240,
+                 action: str = "block"):
+        super().__init__(action)
+        self.max_minutes = max_minutes
+        self.wpm = wpm
+
+    def scan(self, text: str) -> ScanResult:
+        minutes = len(text.split()) / max(self.wpm, 1)
+        if minutes > self.max_minutes:
+            return ScanResult(False, self.name,
+                              f"{minutes:.1f} min read > {self.max_minutes}",
+                              self.action)
+        return ScanResult(True, self.name)
+
+
+class GibberishScanner(Scanner):
+    """Model-free analogue of llm-guard's Gibberish classifier: flags
+    windows of text with abnormal character statistics — very high
+    Shannon entropy (random bytes / key mash), near-zero vowel ratio,
+    or long single-character runs."""
+
+    name = "gibberish"
+
+    def __init__(self, window: int = 80, entropy_max: float = 4.4,
+                 vowel_min: float = 0.12, run_max: int = 12,
+                 action: str = "block"):
+        super().__init__(action)
+        self.window = window
+        self.entropy_max = entropy_max
+        self.vowel_min = vowel_min
+        self.run_max = run_max
+        self._run = re.compile(r"(.)\1{%d,}" % run_max)
+
+    @staticmethod
+    def _entropy(s: str) -> float:
+        import math
+
+        counts: dict[str, int] = {}
+        for ch in s:
+            counts[ch] = counts.get(ch, 0) + 1
+        n = len(s)
+        return -sum(c / n * math.log2(c / n) for c in counts.values())
+
+    def scan(self, text: str) -> ScanResult:
+        if self._run.search(text):
+            return ScanResult(False, self.name,
+                              f"character run > {self.run_max}", self.action)
+        for i in range(0, max(1, len(text) - self.window + 1),
+                       max(1, self.window // 2)):
+            w = text[i:i + self.window]
+            letters = [c for c in w.lower() if c.isalpha()]
+            if len(letters) < self.window // 2:
+                continue
+            vowels = sum(1 for c in letters if c in "aeiou")
+            if vowels / len(letters) < self.vowel_min:
+                return ScanResult(False, self.name,
+                                  "consonant-only window (key mash?)",
+                                  self.action)
+            if len(w) >= self.window and self._entropy(w) > self.entropy_max:
+                return ScanResult(False, self.name,
+                                  "entropy spike (random text?)", self.action)
+        return ScanResult(True, self.name)
+
+
+class CodeScanner(Scanner):
+    """Model-free analogue of llm-guard's Code scanner: blocks (or
+    allows only) code in responses, detected via fenced blocks and a
+    keyword/symbol density heuristic."""
+
+    name = "code"
+    _FENCE = re.compile(r"```(\w*)\n(.*?)```", re.S)
+    _KEYWORDS = re.compile(
+        r"\b(def|return|import|class|public|static|void|function|var|let|"
+        r"const|#include|printf|println|fn|impl|package)\b")
+
+    def __init__(self, mode: str = "block", languages: Sequence[str] = (),
+                 action: str = "block"):
+        super().__init__(action)
+        if mode not in ("block", "allow_only"):
+            raise ValueError(f"code scanner mode {mode!r}")
+        self.mode = mode
+        self.languages = {l.lower() for l in languages}
+
+    def _looks_like_code(self, body: str) -> bool:
+        lines = [l for l in body.splitlines() if l.strip()]
+        if not lines:
+            return False
+        kw = len(self._KEYWORDS.findall(body))
+        symbols = sum(body.count(c) for c in "{};=()")
+        return kw >= 1 or symbols >= max(4, len(lines))
+
+    def scan(self, text: str) -> ScanResult:
+        for lang, body in self._FENCE.findall(text):
+            lang = lang.lower()
+            is_code = bool(lang) or self._looks_like_code(body)
+            if not is_code:
+                continue
+            if self.mode == "block":
+                return ScanResult(False, self.name,
+                                  f"code block ({lang or 'unlabeled'})",
+                                  self.action)
+            if self.languages and lang not in self.languages:
+                return ScanResult(False, self.name,
+                                  f"language {lang or 'unlabeled'!r} not in "
+                                  f"{sorted(self.languages)}", self.action)
+        if self.mode == "block" and not self._FENCE.search(text):
+            # unfenced code: keyword density over the whole text
+            if len(self._KEYWORDS.findall(text)) >= 3 \
+                    and text.count("\n") >= 2:
+                return ScanResult(False, self.name, "unfenced code",
+                                  self.action)
+        return ScanResult(True, self.name)
+
+
+class BanCompetitors(Scanner):
+    """Word-boundary competitor-name matcher (llm-guard BanCompetitors
+    without the NER model)."""
+
+    name = "ban_competitors"
+
+    def __init__(self, competitors: Sequence[str], action: str = "block"):
+        super().__init__(action)
+        self.patterns = [
+            (c, re.compile(r"\b" + re.escape(c) + r"\b", re.I))
+            for c in competitors]
+
+    def scan(self, text: str) -> ScanResult:
+        for name, p in self.patterns:
+            if p.search(text):
+                return ScanResult(False, self.name, f"competitor {name!r}",
+                                  self.action)
+        return ScanResult(True, self.name)
+
+
 _SCANNER_TYPES = {
     "ban_substrings": lambda c: BanSubstrings(
         c.get("substrings", []), c.get("case_sensitive", False),
@@ -153,6 +371,24 @@ _SCANNER_TYPES = {
     "secrets": lambda c: SecretsScanner(c.get("action", "block")),
     "max_length": lambda c: MaxLength(c.get("max_chars", 100000),
                                       c.get("action", "block")),
+    "token_limit": lambda c: TokenLimit(
+        c.get("limit", 4096), c.get("chars_per_token", 4.0),
+        c.get("action", "block")),
+    "invisible_text": lambda c: InvisibleText(c.get("action", "block")),
+    "json": lambda c: JSONScanner(c.get("required", 1),
+                                  c.get("action", "block")),
+    "reading_time": lambda c: ReadingTime(
+        c.get("max_minutes", 5.0), c.get("wpm", 240),
+        c.get("action", "block")),
+    "gibberish": lambda c: GibberishScanner(
+        c.get("window", 80), c.get("entropy_max", 4.4),
+        c.get("vowel_min", 0.12), c.get("run_max", 12),
+        c.get("action", "block")),
+    "code": lambda c: CodeScanner(
+        c.get("mode", "block"), c.get("languages", ()),
+        c.get("action", "block")),
+    "ban_competitors": lambda c: BanCompetitors(
+        c.get("competitors", []), c.get("action", "block")),
 }
 
 

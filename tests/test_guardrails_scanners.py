@@ -1,0 +1,109 @@
+"""New guardrail scanner families (reference scanner_schemas.py parity
+plus model-free analogues of the llm-guard model-based scanners)."""
+
+import pytest
+
+from kaito_tpu.rag.guardrails import (
+    BanCompetitors,
+    CodeScanner,
+    GibberishScanner,
+    InvisibleText,
+    JSONScanner,
+    OutputGuardrails,
+    ReadingTime,
+    TokenLimit,
+    _SCANNER_TYPES,
+)
+
+
+def test_token_limit():
+    s = TokenLimit(limit=10)
+    assert s.scan("short").valid
+    assert not s.scan("x" * 100).valid
+
+
+def test_invisible_text():
+    s = InvisibleText()
+    assert s.scan("plain text").valid
+    assert not s.scan("hid​den").valid          # zero-width space
+    assert not s.scan("bidi ‮ attack").valid    # RLO override
+
+
+def test_json_scanner():
+    s = JSONScanner(required=1)
+    assert s.scan('```json\n{"a": 1}\n```').valid
+    assert s.scan('prefix {"a": [1, 2]} suffix').valid
+    assert not s.scan("no json here").valid
+    assert not s.scan('```json\n{"a": \n```').valid
+
+
+def test_reading_time():
+    s = ReadingTime(max_minutes=0.01, wpm=240)   # ~2.4 words budget
+    assert s.scan("one two").valid
+    assert not s.scan(" ".join(["word"] * 50)).valid
+
+
+def test_gibberish_scanner():
+    s = GibberishScanner()
+    assert s.scan("This is a perfectly normal English sentence about "
+                  "machine learning on TPU hardware.").valid
+    assert not s.scan("a" * 40).valid                       # char run
+    assert not s.scan("xkcdqrtplmnwvzbgfdsqrtplmnwvzbxkcdqrtplmnwvzbgfds"
+                      "qrtplmnwvzbxkcdqrtplmnwvzbgfdsqrt").valid  # no vowels
+
+
+def test_code_scanner_block_mode():
+    s = CodeScanner(mode="block")
+    assert s.scan("The function returns a value conceptually.").valid
+    assert not s.scan("```python\ndef f():\n    return 1\n```").valid
+    assert not s.scan("def f():\n    import os\n    return os.getcwd()\n"
+                      "print(f())").valid              # unfenced
+    # prose-only fenced quote without code signals passes
+    assert s.scan("```\njust a quoted sentence\n```").valid
+
+
+def test_code_scanner_allow_only():
+    s = CodeScanner(mode="allow_only", languages=["python"])
+    assert s.scan("```python\ndef f():\n    return 1\n```").valid
+    assert not s.scan("```javascript\nvar x = 1;\n```").valid
+
+
+def test_ban_competitors():
+    s = BanCompetitors(["Acme Corp", "Globex"])
+    assert s.scan("We compared several options.").valid
+    assert not s.scan("Have you tried acme corp instead?").valid
+    assert s.scan("Acmecorporation is fine (no word boundary)").valid
+
+
+def test_policy_file_builds_all_families(tmp_path):
+    policy = tmp_path / "policy.yaml"
+    policy.write_text("""
+output_scanners:
+  - type: token_limit
+    limit: 1000
+  - type: invisible_text
+  - type: json
+    required: 1
+    action: warn
+  - type: reading_time
+    max_minutes: 5
+  - type: gibberish
+  - type: code
+    mode: block
+  - type: ban_competitors
+    competitors: ["OtherVendor"]
+""")
+    g = OutputGuardrails.from_policy_file(str(policy))
+    assert len(g.scanners) == 7
+    res = g.guard("A normal sentence, mentioning OtherVendor.")
+    assert not res.valid and res.scanner == "ban_competitors"
+
+
+def test_registry_covers_reference_families():
+    """Every reference scanner family (scanner_schemas.py) has an
+    analogue here."""
+    ours = set(_SCANNER_TYPES)
+    for family in ("secrets", "pii", "ban_substrings", "regex",
+                   "invisible_text", "token_limit", "json",
+                   "reading_time"):
+        assert family in ours or family == "pii" and "pii" in ours
