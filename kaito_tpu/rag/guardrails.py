@@ -156,7 +156,7 @@ class TokenLimit(Scanner):
                  action: str = "block"):
         super().__init__(action)
         self.limit = limit
-        self.cpt = chars_per_token
+        self.cpt = max(float(chars_per_token), 0.1)   # policy-typo guard
 
     def scan(self, text: str) -> ScanResult:
         approx = int(len(text) / self.cpt)
@@ -166,11 +166,12 @@ class TokenLimit(Scanner):
         return ScanResult(True, self.name)
 
 
-# zero-width / bidi-control code points (llm-guard InvisibleText checks
-# unicodedata category Cf plus tags/variation selectors)
+# zero-width / bidi-control / tag code points.  Variation selectors
+# (FE00-FE0F) are deliberately NOT here: VS16 emoji ("\u2764\ufe0f")
+# are ordinary rendered output, not hidden text.
 _INVISIBLE = re.compile(
     "[\u200b\u200c\u200d\u200e\u200f\u2060-\u2064"
-    "\u202a-\u202e\ufeff\U000e0000-\U000e007f\ufe00-\ufe0f]")
+    "\u202a-\u202e\ufeff\U000e0000-\U000e007f]")
 
 
 class InvisibleText(Scanner):
@@ -188,30 +189,53 @@ class InvisibleText(Scanner):
 class JSONScanner(Scanner):
     """Require at least ``required`` well-formed JSON objects in the
     output (fenced ```json blocks or bare braces), matching the
-    reference's JSONConfig semantics."""
+    reference's JSONConfig semantics.
+
+    This is a MINIMUM-content requirement, so it only makes sense over
+    the complete response — ``final_only`` defers it to the stream's
+    flush (scanning the first delta would block every stream)."""
 
     name = "json"
+    final_only = True
     _FENCE = re.compile(r"```(?:json)?\s*(\{.*?\}|\[.*?\])\s*```", re.S)
-    _BARE = re.compile(r"(\{.*\}|\[.*\])", re.S)
 
     def __init__(self, required: int = 1, action: str = "block"):
         super().__init__(action)
         self.required = required
 
+    @staticmethod
+    def _bare_objects(text: str) -> int:
+        """Count well-formed bare JSON objects/arrays via raw_decode
+        (handles several per text and trailing prose, which a greedy
+        first-{-to-last-} regex cannot)."""
+        import json as _json
+
+        dec = _json.JSONDecoder()
+        count, idx = 0, 0
+        while True:
+            m = re.search(r"[\{\[]", text[idx:])
+            if not m:
+                return count
+            start = idx + m.start()
+            try:
+                _, end = dec.raw_decode(text, start)
+                count += 1
+                idx = end
+            except ValueError:
+                idx = start + 1
+
     def scan(self, text: str) -> ScanResult:
         import json as _json
 
         valid = 0
-        candidates = self._FENCE.findall(text)
-        if not candidates:
-            m = self._BARE.search(text)
-            candidates = [m.group(1)] if m else []
-        for c in candidates:
+        for c in self._FENCE.findall(text):
             try:
                 _json.loads(c)
                 valid += 1
             except ValueError:
                 continue
+        if valid < self.required:
+            valid += self._bare_objects(self._FENCE.sub("", text))
         if valid < self.required:
             return ScanResult(False, self.name,
                               f"{valid} valid JSON blocks < {self.required}",
@@ -256,7 +280,9 @@ class GibberishScanner(Scanner):
         self.entropy_max = entropy_max
         self.vowel_min = vowel_min
         self.run_max = run_max
-        self._run = re.compile(r"(.)\1{%d,}" % run_max)
+        # alphanumeric runs only: markdown rules/table dividers are
+        # legitimate 13+ runs of '-'/'='/'*'
+        self._run = re.compile(r"([A-Za-z0-9])\1{%d,}" % run_max)
 
     @staticmethod
     def _entropy(s: str) -> float:
@@ -275,7 +301,10 @@ class GibberishScanner(Scanner):
         for i in range(0, max(1, len(text) - self.window + 1),
                        max(1, self.window // 2)):
             w = text[i:i + self.window]
-            letters = [c for c in w.lower() if c.isalpha()]
+            # statistics apply to ASCII-letter text only: CJK/Cyrillic/
+            # Greek output has no ASCII vowels and high unique-char
+            # entropy, and must never read as "gibberish"
+            letters = [c for c in w.lower() if c.isalpha() and c.isascii()]
             if len(letters) < self.window // 2:
                 continue
             vowels = sum(1 for c in letters if c in "aeiou")
@@ -419,8 +448,9 @@ class OutputGuardrails:
         return OutputGuardrails(
             scanners, stream_window=int(policy.get("stream_window", 120)))
 
-    def guard(self, text: str) -> ScanResult:
-        for s in self.scanners:
+    def guard(self, text: str, scanners: Optional[Sequence[Scanner]] = None
+              ) -> ScanResult:
+        for s in (self.scanners if scanners is None else scanners):
             res = s.scan(text)
             if not res.valid:
                 if res.action == "warn":
@@ -435,13 +465,24 @@ class StreamingGuard:
     """Sliding buffer-window scanning for SSE streams (reference:
     ``streaming/{guardrails,buffer_window}.py``): deltas accumulate in a
     window; once a window is clean its prefix is released downstream;
-    a hit blocks the remainder of the stream."""
+    a hit blocks the remainder of the stream.
+
+    Scanners marked ``final_only`` (minimum-content requirements like
+    the JSON scanner) are deferred to :meth:`flush` — running them on a
+    partial stream would block every streamed response on delta one.
+    Incremental scanners see a bounded tail of the accumulated text
+    (several stream windows), keeping per-delta cost constant instead
+    of quadratic in the stream length; the full text is re-scanned
+    once at flush."""
 
     def __init__(self, guardrails: OutputGuardrails):
         self.g = guardrails
         self.buffer = ""
         self.all_text = ""
         self.blocked: Optional[ScanResult] = None
+        self._incremental = [s for s in guardrails.scanners
+                             if not getattr(s, "final_only", False)]
+        self._probe_chars = max(4 * guardrails.stream_window, 2048)
 
     def feed(self, delta: str) -> tuple[str, Optional[ScanResult]]:
         """Returns (text safe to emit now, block result if tripped)."""
@@ -449,7 +490,8 @@ class StreamingGuard:
             return "", self.blocked
         self.buffer += delta
         self.all_text += delta
-        res = self.g.guard(self.all_text)
+        res = self.g.guard(self.all_text[-self._probe_chars:],
+                           self._incremental)
         if not res.valid:
             self.blocked = res
             self.buffer = ""
@@ -464,5 +506,13 @@ class StreamingGuard:
     def flush(self) -> tuple[str, Optional[ScanResult]]:
         if self.blocked:
             return "", self.blocked
+        # complete-response pass: final_only scanners run here, and
+        # incremental scanners get one whole-text scan in case a match
+        # straddled the bounded probe window
+        res = self.g.guard(self.all_text)
+        if not res.valid:
+            self.blocked = res
+            self.buffer = ""
+            return "", res
         out, self.buffer = self.buffer, ""
         return out, None

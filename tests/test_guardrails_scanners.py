@@ -101,9 +101,47 @@ output_scanners:
 
 def test_registry_covers_reference_families():
     """Every reference scanner family (scanner_schemas.py) has an
-    analogue here."""
+    analogue here (the reference's 'sensitive' family is our 'pii')."""
     ours = set(_SCANNER_TYPES)
     for family in ("secrets", "pii", "ban_substrings", "regex",
                    "invisible_text", "token_limit", "json",
                    "reading_time"):
-        assert family in ours or family == "pii" and "pii" in ours
+        assert family in ours
+
+
+def test_json_scanner_multiple_bare_objects():
+    s = JSONScanner(required=2)
+    assert s.scan('{"a": 1} and also {"b": 2}').valid
+    assert not s.scan('{"a": 1} only one').valid
+
+
+def test_streaming_defers_json_and_allows_markdown():
+    """A streamed response under a json+gibberish policy: deltas pass
+    (json is final_only; markdown rules are not char runs), and the
+    flush validates the complete text."""
+    from kaito_tpu.rag.guardrails import StreamingGuard
+
+    g = OutputGuardrails([JSONScanner(required=1), GibberishScanner()],
+                         stream_window=8)
+    sg = StreamingGuard(g)
+    text = 'Here is a table:\n----------------\n```json\n{"ok": true}\n```'
+    emitted = ""
+    for i in range(0, len(text), 7):
+        out, blocked = sg.feed(text[i:i + 7])
+        assert blocked is None, blocked
+        emitted += out
+    out, blocked = sg.flush()
+    assert blocked is None
+    assert emitted + out == text
+
+    # and a stream that never produces JSON blocks at flush, not before
+    sg2 = StreamingGuard(g)
+    out, blocked = sg2.feed("no json at all, just prose about things")
+    assert blocked is None
+    _, blocked = sg2.flush()
+    assert blocked is not None and blocked.scanner == "json"
+
+
+def test_emoji_and_cjk_pass():
+    assert InvisibleText().scan("I ❤️ TPUs").valid
+    assert GibberishScanner().scan("这是一个完全正常的中文句子，讨论机器学习。" * 4).valid
