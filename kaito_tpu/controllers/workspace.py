@@ -100,21 +100,49 @@ class WorkspaceReconciler(Reconciler):
             extra_labels=dict(ws.resource.label_selector),
             preferred_nodes=list(ws.resource.preferred_nodes))
         self.provisioner.provision(req)
-        ready, nodes = self.provisioner.ensure_ready(req)
+        # snapshot-capable provisioners (karpenter) build ONE snapshot
+        # per reconcile: readiness, node list, and the status condition
+        # all derive from it (reference nodeReadinessSnapshot/
+        # CollectNodeStatusInfo, provisioner.go:391-560)
+        snap_cond = None
+        if hasattr(self.provisioner, "ensure_ready_snapshot"):
+            snap = self.provisioner.ensure_ready_snapshot(req)
+            ready, nodes = snap.all_ready, snap.ready_nodes
+            snap_cond = snap.condition()
+        else:
+            ready, nodes = self.provisioner.ensure_ready(req)
+        # node repair runs regardless of overall readiness: a dead node
+        # in an otherwise-covered slice still pins its pool replica
+        # slot and must be replaced
+        if hasattr(self.provisioner, "repair_unhealthy"):
+            repaired = self.provisioner.repair_unhealthy(req)
+            if repaired:
+                logger.info("repairing NotReady nodes for %s: %s",
+                            ws.metadata.name, repaired)
+        prov_s = (self.provisioner.provision_seconds(req)
+                  if hasattr(self.provisioner, "provision_seconds") else None)
 
         def set_target(o):
             o.status.target_node_count = plan.num_hosts * ws.resource.count
             o.status.worker_nodes = nodes
             o.status.observed_generation = o.metadata.generation
+            if prov_s is not None:
+                o.status.performance.metrics[
+                    "provision_to_ready_seconds"] = round(prov_s, 3)
         ws = update_with_retry(self.store, "Workspace", ws.metadata.namespace,
                                ws.metadata.name, set_target)
 
         if not ready:
             self._set_cond(ws, COND_NODE_CLAIM_READY, "False",
-                           "Provisioning", f"{len(nodes)} nodes ready")
+                           snap_cond["reason"] if snap_cond else "Provisioning",
+                           snap_cond["message"] if snap_cond
+                           else f"{len(nodes)} nodes ready")
             return Result(requeue_after=5.0)
+        ready_msg = f"{len(nodes)} nodes ready"
+        if prov_s is not None:
+            ready_msg += f" (provisioned in {prov_s:.1f}s)"
         self._set_cond(ws, COND_NODE_CLAIM_READY, "True", "NodesReady",
-                       f"{len(nodes)} nodes ready")
+                       ready_msg)
         self._set_cond(ws, COND_RESOURCE_READY, "True", "ResourceReady", "")
 
         # weight cache gate (reference: ensureModelMirror :173 +
@@ -258,6 +286,16 @@ class WorkspaceReconciler(Reconciler):
                 cur.spec["template"] = new_tmpl
             update_with_retry(self.store, obj.kind, obj.metadata.namespace,
                               obj.metadata.name, mutate)
+        elif obj.kind == "Service" and existing.spec != obj.spec:
+            # Services drift too (ports/selector edits must reconcile
+            # back); clusterIP-style immutable fields aren't modeled
+            # in-process, so the rendered spec wins wholesale.  The
+            # equality gate keeps no-drift resyncs write-free (no
+            # resourceVersion churn / spurious MODIFIED events).
+            def mutate_svc(cur):
+                cur.spec = dict(obj.spec)
+            update_with_retry(self.store, obj.kind, obj.metadata.namespace,
+                              obj.metadata.name, mutate_svc)
 
     def _set_cond(self, ws: Workspace, type_: str, status: str, reason: str,
                   message: str) -> None:
