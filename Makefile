@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: all native unit-test engine-test rag-test bench serve manager clean
+.PHONY: all native unit-test unit-test-fast unit-test-slow engine-test rag-test bench serve manager clean
 
 all: native
 
@@ -12,6 +12,14 @@ native:
 
 unit-test:
 	$(PYTHON) -m pytest tests/ -q
+
+# operator/controller/RAG/API surface only — skips the compile-heavy
+# engine/mesh tier (marked slow); finishes in well under a minute
+unit-test-fast:
+	$(PYTHON) -m pytest tests/ -q -m "not slow"
+
+unit-test-slow:
+	$(PYTHON) -m pytest tests/ -q -m "slow"
 
 engine-test:
 	$(PYTHON) -m pytest tests/test_engine_core.py tests/test_engine_model.py \
