@@ -145,6 +145,12 @@ def update_score_table(path: str, model_name: str, summary: dict) -> None:
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         f.write("# MT-Bench scores (kaito-tpu engine)\n\n")
+        f.write("Rows are MEASURED by run_mt_bench.py: answers served "
+                "by the engine, scored by the judge loop.  Rows marked "
+                "\"synthetic weights\" prove the harness end to end "
+                "(a synthetic-weight judge emits no valid ratings, so "
+                "they score 0.00); real scores require a real "
+                "checkpoint mounted under --weights-dir.\n\n")
         f.write(TABLE_HEADER + "\n" + sep + "\n")
         f.write("\n".join(ordered) + "\n")
     os.replace(tmp, path)
