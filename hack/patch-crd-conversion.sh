@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# Stamp the CRD conversion webhooks with the INSTALLED chart's service
+# coordinates and CA bundle.  The static CRDs in config/crd/ declare
+# strategy: Webhook with placeholder coordinates; the apiserver
+# requires a caBundle matching the webhook's serving cert, which the
+# chart generates per-install (charts/kaito-tpu/templates/webhook.yaml)
+# — so conversion goes live only after this patch runs.
+#
+# Usage: hack/patch-crd-conversion.sh [release-name] [namespace]
+set -euo pipefail
+
+RELEASE="${1:-kaito-tpu}"
+NAMESPACE="${2:-kaito-tpu-system}"
+SECRET="${RELEASE}-webhook-certs"
+
+CA=$(kubectl get secret "${SECRET}" -n "${NAMESPACE}" \
+  -o jsonpath='{.data.ca\.crt}')
+if [ -z "${CA}" ]; then
+  echo "error: secret ${NAMESPACE}/${SECRET} has no ca.crt (is the chart installed?)" >&2
+  exit 1
+fi
+
+for crd in workspaces.kaito-tpu.io ragengines.kaito-tpu.io; do
+  kubectl patch crd "${crd}" --type merge -p "{
+    \"spec\": {\"conversion\": {\"strategy\": \"Webhook\", \"webhook\": {
+      \"conversionReviewVersions\": [\"v1\"],
+      \"clientConfig\": {
+        \"caBundle\": \"${CA}\",
+        \"service\": {\"name\": \"${RELEASE}-webhook\",
+                       \"namespace\": \"${NAMESPACE}\",
+                       \"path\": \"/convert\", \"port\": 443}}}}}}"
+  echo "patched ${crd}"
+done

@@ -92,6 +92,14 @@ class AdmissionHandler(BaseHTTPRequestHandler):
     def log_message(self, *a):
         pass
 
+    def _write_json(self, payload: dict):
+        body = json.dumps(payload).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def _respond(self, review: dict, allowed: bool, message: str = "",
                  patch: Optional[list] = None):
         resp = {"uid": review.get("request", {}).get("uid", ""),
@@ -101,19 +109,34 @@ class AdmissionHandler(BaseHTTPRequestHandler):
         if patch:
             resp["patchType"] = "JSONPatch"
             resp["patch"] = base64.b64encode(json.dumps(patch).encode()).decode()
-        body = json.dumps({"apiVersion": "admission.k8s.io/v1",
-                           "kind": "AdmissionReview",
-                           "response": resp}).encode()
-        self.send_response(200)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
+        self._write_json({"apiVersion": "admission.k8s.io/v1",
+                          "kind": "AdmissionReview",
+                          "response": resp})
+
+    def _respond_conversion(self, review: dict):
+        """CRD ConversionReview v1 (reference: the conversion webhook
+        behind api/v1alpha1/*_conversion.go): objects convert to the
+        requested version in EITHER direction — spoke->hub on writes
+        of legacy manifests, hub->spoke when clients read at the
+        served legacy version."""
+        from kaito_tpu.api.conversion import HUB_VERSION, convert
+
+        req = review.get("request", {})
+        desired = req.get("desiredAPIVersion", "") or HUB_VERSION
+        converted = [convert(obj, desired) for obj in req.get("objects", [])]
+        self._write_json({
+            "apiVersion": "apiextensions.k8s.io/v1",
+            "kind": "ConversionReview",
+            "response": {"uid": req.get("uid", ""),
+                         "result": {"status": "Success"},
+                         "convertedObjects": converted}})
 
     def do_POST(self):
         try:
             n = int(self.headers.get("Content-Length", "0"))
             review = json.loads(self.rfile.read(n))
+            if self.path.startswith("/convert"):
+                return self._respond_conversion(review)
             req = review.get("request", {})
             kind = req.get("kind", {}).get("kind", "")
             obj = req.get("object", {}) or {}

@@ -231,16 +231,36 @@ class WorkspaceReconciler(Reconciler):
         # status, same contract re-homed)
         bench = (ss.status.get("benchmark") if ss else None) or {}
         if benchmark and ready and bench:
-            def record(o):
-                o.status.performance.metrics[BENCH_METRIC_PEAK_TPM] = float(
-                    bench.get("total_tpm", 0.0))
-                o.status.performance.config = {
-                    k: str(v) for k, v in bench.items() if k != "total_tpm"}
-            ws = update_with_retry(self.store, "Workspace",
-                                   ws.metadata.namespace, ws.metadata.name,
-                                   record)
-            self._set_cond(ws, COND_BENCHMARK_COMPLETE, "True",
-                           "BenchmarkComplete", "")
+            # failure surfaces as a condition instead of silently
+            # recording zeros (reference: benchmark result parse
+            # failures flip the workspace condition, benchmark.go)
+            try:
+                tpm = float(bench.get("total_tpm") or 0.0)
+                n_errors = int(bench.get("errors") or 0)
+                failed = bool(bench.get("error")) or (
+                    tpm <= 0.0 and n_errors > 0)
+                fail_msg = str(bench.get("error")
+                               or f"{n_errors} request errors, "
+                                  f"zero throughput")
+            except (TypeError, ValueError) as e:
+                # a malformed payload IS a benchmark failure — it must
+                # flip the condition, not crash the reconcile
+                failed, fail_msg = True, f"malformed benchmark result: {e}"
+            if failed:
+                self._set_cond(ws, COND_BENCHMARK_COMPLETE, "False",
+                               "BenchmarkFailed", fail_msg)
+            else:
+                def record(o):
+                    o.status.performance.metrics[BENCH_METRIC_PEAK_TPM] = \
+                        float(bench.get("total_tpm", 0.0))
+                    o.status.performance.config = {
+                        k: str(v) for k, v in bench.items()
+                        if k != "total_tpm"}
+                ws = update_with_retry(self.store, "Workspace",
+                                       ws.metadata.namespace,
+                                       ws.metadata.name, record)
+                self._set_cond(ws, COND_BENCHMARK_COMPLETE, "True",
+                               "BenchmarkComplete", "")
         if ready:
             self._set_cond(ws, COND_WORKSPACE_SUCCEEDED, "True", "Ready", "")
         return Result() if ready else Result(requeue_after=5.0)

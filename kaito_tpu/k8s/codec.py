@@ -180,6 +180,11 @@ def to_wire(obj: KaitoObject) -> dict:
 
 
 def from_wire(d: dict) -> KaitoObject:
+    from kaito_tpu.api.conversion import convert_to_hub, is_legacy
+
+    if is_legacy(d):
+        # hub-and-spoke conversion (reference: ragengine_conversion.go)
+        d = convert_to_hub(d)
     kind = d["kind"]
     meta = meta_from_wire(d.get("metadata", {}))
     if kind == "ControllerRevision":

@@ -1,0 +1,155 @@
+"""Legacy API version conversion (hub-and-spoke, reference
+api/v1alpha1/ragengine_conversion.go) + benchmark-failure condition."""
+
+from kaito_tpu.api.conversion import convert_to_hub
+from kaito_tpu.k8s.codec import from_wire
+
+
+def _legacy_rag(storage=None, svc=None):
+    return {
+        "apiVersion": "kaito-tpu.io/v1alpha1",
+        "kind": "RAGEngine",
+        "metadata": {"name": "rag1", "namespace": "default"},
+        "spec": {
+            "compute": {"instanceType": "ct5lp-hightpu-1t"},
+            "storage": storage if storage is not None else {
+                "persistentVolumeClaim": "rag-pvc",
+                "mountPath": "/data"},
+            "inferenceService": svc if svc is not None else {
+                "URL": "http://svc:5000", "AccessSecret": "tok"},
+        },
+    }
+
+
+def test_ragengine_v1alpha1_storage_nests():
+    hub = convert_to_hub(_legacy_rag())
+    assert hub["apiVersion"] == "kaito-tpu.io/v1"
+    st = hub["spec"]["storage"]
+    assert st["persistentVolume"] == {
+        "persistentVolumeClaim": "rag-pvc", "mountPath": "/data"}
+    assert "persistentVolumeClaim" not in st
+    svc = hub["spec"]["inferenceService"]
+    assert svc["url"] == "http://svc:5000"
+    assert svc["accessSecret"] == "tok"
+
+
+def test_conversion_never_mutates_input_and_is_idempotent():
+    legacy = _legacy_rag()
+    hub = convert_to_hub(legacy)
+    assert legacy["apiVersion"] == "kaito-tpu.io/v1alpha1"   # untouched
+    assert convert_to_hub(hub) == hub                        # no-op on hub
+
+
+def test_downgrade_restores_legacy_shape():
+    """Hub -> spoke: clients reading at v1alpha1 see the FLAT legacy
+    shape (a relabeled hub object would make kubectl apply of legacy
+    manifests diff forever)."""
+    from kaito_tpu.api.conversion import convert, convert_from_hub
+
+    hub = convert_to_hub(_legacy_rag())
+    down = convert_from_hub(hub, "kaito-tpu.io/v1alpha1")
+    st = down["spec"]["storage"]
+    assert st["persistentVolumeClaim"] == "rag-pvc"
+    assert st["mountPath"] == "/data"
+    assert "persistentVolume" not in st
+    assert down["spec"]["inferenceService"]["URL"] == "http://svc:5000"
+    # full round trip is stable
+    assert convert(down, "kaito-tpu.io/v1") == hub
+
+
+def test_half_migrated_manifest_drops_nothing():
+    """storage carrying BOTH flat keys and a persistentVolume block
+    keeps both on upgrade (never drop fields)."""
+    legacy = _legacy_rag(storage={
+        "persistentVolumeClaim": "flat-pvc", "mountPath": "/flat",
+        "persistentVolume": {"persistentVolumeClaim": "nested-pvc",
+                             "mountPath": "/nested"}})
+    hub = convert_to_hub(legacy)
+    st = hub["spec"]["storage"]
+    assert st["persistentVolume"]["persistentVolumeClaim"] == "nested-pvc"
+    assert st["persistentVolumeClaim"] == "flat-pvc"   # preserved
+
+
+def test_from_wire_decodes_legacy_ragengine():
+    obj = from_wire(_legacy_rag())
+    assert obj.kind == "RAGEngine"
+    assert obj.spec.storage.persistent_volume == {
+        "persistentVolumeClaim": "rag-pvc", "mountPath": "/data"}
+    assert obj.spec.inference_service.url == "http://svc:5000"
+
+
+def test_workspace_v1alpha1_tuning_method_alias():
+    hub = convert_to_hub({
+        "apiVersion": "kaito-tpu.io/v1alpha1", "kind": "Workspace",
+        "metadata": {"name": "w"},
+        "tuning": {"method": "qlora", "preset": "phi-4-mini-instruct"}})
+    assert hub["tuning"]["method"] == "QLoRA"
+
+
+def test_conversion_webhook_review():
+    """The CRD ConversionReview endpoint upgrades objects in bulk."""
+    import json
+    import threading
+    import urllib.request
+
+    from kaito_tpu.controllers.webhook import make_server
+
+    srv = make_server(host="127.0.0.1", port=0)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        review = {
+            "apiVersion": "apiextensions.k8s.io/v1",
+            "kind": "ConversionReview",
+            "request": {"uid": "u1",
+                        "desiredAPIVersion": "kaito-tpu.io/v1",
+                        "objects": [_legacy_rag()]}}
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/convert",
+            data=json.dumps(review).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            out = json.loads(r.read())
+        resp = out["response"]
+        assert resp["uid"] == "u1"
+        assert resp["result"]["status"] == "Success"
+        conv = resp["convertedObjects"][0]
+        assert conv["apiVersion"] == "kaito-tpu.io/v1"
+        assert "persistentVolume" in conv["spec"]["storage"]
+    finally:
+        srv.shutdown()
+
+
+def test_benchmark_failure_sets_condition():
+    from kaito_tpu.api import (
+        InferenceSpec,
+        ObjectMeta,
+        ResourceSpec,
+        Workspace,
+    )
+    from kaito_tpu.api.workspace import COND_BENCHMARK_COMPLETE
+    from kaito_tpu.controllers.runtime import Store, update_with_retry
+    from kaito_tpu.controllers.workspace import WorkspaceReconciler
+    from kaito_tpu.provision import FakeCloud, KarpenterTPUProvisioner
+
+    store = Store()
+    cloud = FakeCloud(store)
+    rec = WorkspaceReconciler(store, KarpenterTPUProvisioner(store))
+    ws = Workspace(ObjectMeta(name="benched"),
+                   resource=ResourceSpec(instance_type="ct5lp-hightpu-1t"),
+                   inference=InferenceSpec(preset="phi-4-mini-instruct"))
+    store.create(ws)
+    for _ in range(6):
+        rec.reconcile_key("default", "benched")
+        cloud.tick()
+
+    def post_failed_bench(ss):
+        ss.status["benchmark"] = {"error": "probe timeout", "total_tpm": 0}
+    update_with_retry(store, "StatefulSet", "default", "benched",
+                      post_failed_bench)
+    rec.reconcile_key("default", "benched")
+    ws = store.get("Workspace", "default", "benched")
+    cond = next(c for c in ws.status.conditions
+                if c.type == COND_BENCHMARK_COMPLETE)
+    assert cond.status == "False" and cond.reason == "BenchmarkFailed"
+    assert "probe timeout" in cond.message
