@@ -57,19 +57,59 @@ def bench_serving_path(model_name: str, on_tpu: bool, quant: str = ""):
 
     Returns {"server_tok_s", "server_tpm", "ttft_p50_ms@2048in", ...}.
     """
+    if on_tpu:
+        # walked down on HBM exhaustion: the fused-decode program's
+        # sampler temps ([B, 200k] sorts) live in the overhead budget
+        # and can tip a 16 GiB chip at the widest batch
+        seq_ladder = (96, 64, 48)
+    else:
+        seq_ladder = (4,)
+    last_msg = ""
+    for i, max_seqs in enumerate(seq_ladder):
+        try:
+            return _bench_serving_once(model_name, on_tpu, quant, max_seqs)
+        except Exception as e:
+            msg = f"{type(e).__name__}: {str(e)[:300]}"
+            retryable = ("RESOURCE_EXHAUSTED" in str(e)
+                         or isinstance(e, _ServingStall))
+            # drop the traceback BEFORE the next rung: it pins the
+            # failed attempt's engine (weights + KV pool) in HBM, which
+            # would OOM every lower rung too
+            e.__traceback__ = None
+            del e
+            if not retryable or i + 1 == len(seq_ladder):
+                raise RuntimeError(f"serving bench failed at batch "
+                                   f"{max_seqs}: {msg}")
+            last_msg = msg
+            log(f"[server] batch {max_seqs} failed ({msg}); walking down")
+    raise RuntimeError(last_msg)
+
+
+class _ServingStall(RuntimeError):
+    """The engine loop swallowed step failures into a silent stall
+    (fails in-flight requests and carries on) — retryable at a
+    narrower batch."""
+
+
+def _bench_serving_once(model_name: str, on_tpu: bool, quant: str,
+                        max_seqs: int) -> dict:
     import jax
 
     from kaito_tpu.engine.config import EngineConfig
     from kaito_tpu.engine.engine import InferenceEngine, SamplingParams
 
     if on_tpu:
-        max_seqs, prompt_len, out_toks = 96, 128, 256
+        prompt_len, out_toks = 128, 256
         window_s, warm_min_s, warm_max_s = 45.0, 15.0, 300.0
         probe_len, n_probes = 2048, 8
         max_len, dtype = 2560, "bfloat16"
         buckets = (128, 512)      # 512 = chunked-prefill ctx bucket
+        # reserve extra HBM for program temps beyond the engine's
+        # default overhead allowance (the [B, vocab] sampler sort in
+        # the fused-decode program is the biggest)
+        os.environ.setdefault("KAITO_HBM_BYTES", str(15 * 1024 ** 3))
     else:   # tiny, CPU-testable shape of the same phases
-        max_seqs, prompt_len, out_toks = 4, 32, 16
+        prompt_len, out_toks = 32, 16
         window_s, warm_min_s, warm_max_s = 5.0, 1.0, 120.0
         probe_len, n_probes = 256, 3
         max_len, dtype = 320, "float32"
@@ -114,14 +154,25 @@ def bench_serving_path(model_name: str, on_tpu: bool, quant: str = ""):
         # a steady clip (decode counter advancing with all slots busy)
         t0 = time.monotonic()
         last = -1
+        warmed = False
         while time.monotonic() - t0 < warm_max_s:
             time.sleep(1.0)
             d = eng.counters["decode_steps_total"]
             if (time.monotonic() - t0 >= warm_min_s and d > 50
                     and eng.num_running >= max(1, max_seqs // 2)
                     and d != last):
+                warmed = True
                 break
             last = d
+        if not warmed:
+            # a compile OOM or repeated step failure shows up as a
+            # stalled (or never-started) decode counter; surface it so
+            # the batch ladder can walk down instead of measuring ~0
+            raise _ServingStall(
+                f"engine never reached steady decode within "
+                f"{warm_max_s:.0f}s at batch {max_seqs} "
+                f"(steps={eng.counters['decode_steps_total']}, "
+                f"running={eng.num_running})")
         log(f"[server] warm after {time.monotonic() - t0:.0f}s; "
             f"running={eng.num_running} waiting={eng.num_waiting}")
 
