@@ -312,11 +312,9 @@ def main():
     log(f"params ready in {time.monotonic() - t0:.1f}s "
         f"({sum(x.nbytes for x in jax.tree.leaves(params)) / 2**30:.2f} GiB)")
     if args.quant == "int8":
-        from functools import partial as _partial
-
         from kaito_tpu.engine.quant import quantize_params
 
-        params = jax.jit(_partial(quantize_params, arch=arch))(params)
+        params = jax.jit(quantize_params)(params)
         jax.block_until_ready(params)
         log(f"int8 weights: "
             f"{sum(x.nbytes for x in jax.tree.leaves(params)) / 2**30:.2f} GiB")

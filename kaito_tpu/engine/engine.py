@@ -195,16 +195,9 @@ class InferenceEngine:
         self.buckets = tuple(sorted(
             {b for b in cfg.prefill_buckets if b < cfg.max_model_len}
             | {cfg.max_model_len}))
-        if cfg.quantization:
+        if cfg.quantization and cfg.quantization != "int8":
             # fail fast BEFORE any allocation or weight loading
-            if cfg.quantization != "int8":
-                raise ValueError(f"unknown quantization {cfg.quantization!r}")
-            from kaito_tpu.engine.quant import supports_quantization
-
-            if not supports_quantization(arch):
-                raise ValueError(
-                    "int8 serving currently covers dense GQA families only "
-                    "(MLA or MoE layers present)")
+            raise ValueError(f"unknown quantization {cfg.quantization!r}")
 
         # params BEFORE the KV pool: sizing reads the ACTUAL resident
         # weight bytes (post-quantization), and quantizing with a
@@ -230,8 +223,7 @@ class InferenceEngine:
                 qkw = ({"out_shardings": self._quantized_param_shardings()}
                        if self.mesh is not None else {})
                 self.params = jax.jit(
-                    partial(quantize_params, arch=self.md.arch),
-                    donate_argnums=0, **qkw)(self.params)
+                    quantize_params, donate_argnums=0, **qkw)(self.params)
                 jax.block_until_ready(self.params)
                 logger.info(
                     "int8 weights ready in %.1fs (%.2f GiB)",
@@ -536,8 +528,7 @@ class InferenceEngine:
         t0 = time.monotonic()
 
         def init_q(key):
-            return quantize_params(self.model.init_params(key),
-                                   arch=self.md.arch)
+            return quantize_params(self.model.init_params(key))
 
         if self.mesh is not None:
             params = jax.jit(

@@ -282,15 +282,16 @@ class TransformerLM:
         dl = a.kv_lora_rank or 512
 
         if "q_a" in p:
-            q_lat = nn.rms_norm(h @ p["q_a"], p["q_a_norm"], a.rms_norm_eps, False)
-            q = q_lat @ p["q_b"]
+            q_lat = nn.rms_norm(nn.linear(h, p["q_a"]), p["q_a_norm"],
+                                a.rms_norm_eps, False)
+            q = nn.linear(q_lat, p["q_b"])
         else:
-            q = h @ p["q"]
+            q = nn.linear(h, p["q"])
         q = q.reshape(B, T, H, dn + dr)
         q_nope, q_rope = q[..., :dn], q[..., dn:]
         q_rope = nn.apply_rope(q_rope, positions, self._inv_freq_global, dr)
 
-        kv = h @ p["kv_a"]                       # [B, T, dl+dr]
+        kv = nn.linear(h, p["kv_a"])             # [B, T, dl+dr]
         c_kv = nn.rms_norm(kv[..., :dl], p["kv_a_norm"], a.rms_norm_eps, False)
         k_rope = nn.apply_rope(kv[..., dl:][:, :, None, :], positions,
                                self._inv_freq_global, dr)[:, :, 0]
@@ -326,7 +327,7 @@ class TransformerLM:
                 p["kv_b_k"], p["kv_b_v"], scale=self._scale,
                 kv_lora_rank=dl, layer=li)[:, None]
         dv = a.v_head_dim or a.head_dim
-        attn_out = out.reshape(B, T, H * dv) @ p["o"]
+        attn_out = nn.linear(out.reshape(B, T, H * dv), p["o"])
         return attn_out, ck, cv
 
     # ------------------------------------------------------------------

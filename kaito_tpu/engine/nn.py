@@ -24,7 +24,9 @@ def linear(x: jax.Array, w) -> jax.Array:
     symmetric quantization).  Under jit the int8 stays in HBM and the
     dequant fuses into the dot — the QLoRA memory model.
     """
-    if isinstance(w, dict) and "q8" in w:
+    from kaito_tpu.engine.quant import is_qtensor
+
+    if is_qtensor(w):
         return (x @ w["q8"].astype(x.dtype)) * w["scale"].astype(x.dtype)
     return x @ w
 
@@ -199,10 +201,21 @@ def moe_mlp(x: jax.Array, p: dict, arch: ModelArch) -> jax.Array:
     route = jnp.zeros((T, X), jnp.float32)
     route = route.at[jnp.arange(T)[:, None], idx].set(weights)
     # dense expert compute: h[x] = act(x @ gate_x) * (x @ up_x) @ down_x
-    gate = jnp.einsum("te,xei->txi", x, p["experts_gate"])
-    up = jnp.einsum("te,xei->txi", x, p["experts_up"])
+    def expert_dot(spec, lhs, w):
+        """einsum accepting a plain [X, in, out] stack or an int8
+        QTensor {"q8", "scale": [X, out]} (dequant fuses into the dot;
+        the per-expert scale rides the output's [x, out] dims)."""
+        from kaito_tpu.engine.quant import is_qtensor
+
+        if is_qtensor(w):
+            return jnp.einsum(spec, lhs, w["q8"].astype(lhs.dtype)) \
+                * w["scale"].astype(lhs.dtype)
+        return jnp.einsum(spec, lhs, w)
+
+    gate = expert_dot("te,xei->txi", x, p["experts_gate"])
+    up = expert_dot("te,xei->txi", x, p["experts_up"])
     h = activation(gate, arch.hidden_act) * up
-    out = jnp.einsum("txi,xie->txe", h, p["experts_down"])
+    out = expert_dot("txi,xie->txe", h, p["experts_down"])
     y = jnp.einsum("txe,tx->te", out.astype(jnp.float32), route).astype(x.dtype)
     if "shared_gate" in p:
         shared = {"gate": p["shared_gate"], "up": p["shared_up"], "down": p["shared_down"]}
@@ -231,14 +244,26 @@ def moe_mlp_ragged(x: jax.Array, p: dict, arch: ModelArch) -> jax.Array:
     token_of = order // k                              # originating token
     x_sorted = x[token_of]                             # [T*k, E]
     group_sizes = jnp.bincount(flat_expert, length=X)
+    expert_of_row = flat_expert[order]                 # [T*k]
 
-    gate = jax.lax.ragged_dot(x_sorted, p["experts_gate"], group_sizes,
-                              preferred_element_type=jnp.float32)
-    up = jax.lax.ragged_dot(x_sorted, p["experts_up"], group_sizes,
-                            preferred_element_type=jnp.float32)
+    def ragged(lhs, w):
+        """ragged_dot accepting a plain stack or an int8 QTensor: the
+        convert fuses into the grouped GEMM's RHS load, and each row's
+        output scales by its expert's per-out-channel scale."""
+        from kaito_tpu.engine.quant import is_qtensor
+
+        if is_qtensor(w):
+            out = jax.lax.ragged_dot(lhs, w["q8"].astype(lhs.dtype),
+                                     group_sizes,
+                                     preferred_element_type=jnp.float32)
+            return out * w["scale"][expert_of_row].astype(out.dtype)
+        return jax.lax.ragged_dot(lhs, w, group_sizes,
+                                  preferred_element_type=jnp.float32)
+
+    gate = ragged(x_sorted, p["experts_gate"])
+    up = ragged(x_sorted, p["experts_up"])
     h = (activation(gate, arch.hidden_act) * up).astype(x.dtype)
-    out_sorted = jax.lax.ragged_dot(h, p["experts_down"], group_sizes,
-                                    preferred_element_type=jnp.float32)
+    out_sorted = ragged(h, p["experts_down"])
 
     w_sorted = weights.reshape(-1)[order]
     y = jnp.zeros((T, E), jnp.float32).at[token_of].add(

@@ -1,19 +1,23 @@
 """Weight-only int8 quantization for serving.
 
 Per-out-channel symmetric int8: each matmul weight ``[.., in, out]``
-becomes ``{"q8": int8, "scale": f32[.., out]}``; ``nn.linear`` dequants
-on use, so under jit the int8 stays in HBM and the dequant fuses into
-the dot.  Decode is parameter-bandwidth-bound on TPU, so halving the
-weight bytes is a direct throughput lever — the serving counterpart of
-the quantized presets the reference runs through vLLM
-(``--quantization`` in inference_api.py; preset quant methods in
-presets/workspace/generator/generator.go).
+becomes ``{"q8": int8, "scale": f32[.., out]}``; ``nn.linear`` (and the
+MoE einsum/ragged paths) dequant on use, so under jit the int8 stays in
+HBM and the dequant fuses into the dot.  Decode is parameter-bandwidth-
+bound on TPU, so halving the weight bytes is a direct throughput lever
+— the serving counterpart of the quantized presets the reference runs
+through vLLM (``--quantization`` in inference_api.py; preset quant
+methods in presets/workspace/generator/generator.go).
 
-Scope (round 2): the dense GQA families.  Attention q/k/v/o and MLP
-gate/up/down quantize; embeddings, norms, biases, and the (often tied)
-lm_head stay bf16 — the logits matmul is quality-critical and the
-embedding gather needs the full-precision table anyway.  MLA and MoE
-presets are rejected for now (their projections bypass nn.linear).
+Coverage (round 3): every family.  Dense GQA q/k/v/o + MLP gate/up/
+down; MLA's latent projections (q_a/q_b/q, kv_a, o — the absorbed
+kv_b_k/kv_b_v expansion matrices stay bf16: they multiply inside the
+attention kernels every step and are small); MoE expert stacks
+(per-(layer, expert, out-channel) scales) and shared-expert MLPs (the
+router stays full precision — routing logits are quality-critical and
+tiny).  Embeddings, norms, biases, and the (often tied) lm_head stay
+bf16 — the logits matmul is quality-critical and the embedding gather
+needs the full-precision table anyway.
 """
 
 from __future__ import annotations
@@ -21,18 +25,34 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from kaito_tpu.models.metadata import AttentionKind, ModelArch
+from kaito_tpu.models.metadata import ModelArch
 
-# layer-stack keys that flow through nn.linear and are safe to quantize
-QUANT_KEYS = ("q", "k", "v", "o", "gate", "up", "down")
+# layer-stack keys whose matmuls dequant-on-use: dense attention + MLP,
+# MLA latent projections, MoE expert stacks and shared experts
+QUANT_KEYS = (
+    "q", "k", "v", "o", "gate", "up", "down",
+    "q_a", "q_b", "kv_a",
+    "experts_gate", "experts_up", "experts_down",
+    "shared_gate", "shared_up", "shared_down",
+)
 
-# the group quantize_params touches (dense GQA families only)
-QUANT_GROUP = "dense"
+
+def supports_quantization(arch: ModelArch) -> bool:
+    return True   # every family since round 3 (kept for API stability)
 
 
 def is_quantized_leaf(group: str, name: str) -> bool:
-    """Whether quantize_params turns params[group][name] into a QTensor."""
-    return group == QUANT_GROUP and name in QUANT_KEYS
+    """Whether quantize_params turns params[group][name] into a QTensor
+    (``group`` is a layer-group name — serve_lora stacks never
+    quantize)."""
+    return group != "serve_lora" and name in QUANT_KEYS
+
+
+def is_qtensor(w) -> bool:
+    """The QTensor shape test used by every dequant-on-use call site
+    (nn.linear, the MoE einsum/ragged paths) — the representation is
+    defined here, next to quantize_weight."""
+    return isinstance(w, dict) and "q8" in w
 
 
 def qtensor_logical_axes(ax: tuple) -> dict:
@@ -42,12 +62,13 @@ def qtensor_logical_axes(ax: tuple) -> dict:
     return {"q8": ax, "scale": ax[:-2] + ax[-1:]}
 
 
-def supports_quantization(arch: ModelArch) -> bool:
-    return arch.attention_kind != AttentionKind.MLA and arch.num_experts == 0
-
-
 def quantize_weight(w: jax.Array) -> dict:
-    """[.., in, out] bf16/f32 -> {"q8": int8, "scale": f32[.., out]}."""
+    """[.., in, out] bf16/f32 -> {"q8": int8, "scale": f32[.., out]}.
+
+    Works for any rank: stacked layer weights [L, in, out] get
+    per-(layer, out-channel) scales; MoE stacks [L, X, in, out] get
+    per-(layer, expert, out-channel) scales.
+    """
     scale = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2) / 127.0
     scale = jnp.maximum(scale, 1e-8)
     q8 = jnp.round(w.astype(jnp.float32) / scale[..., None, :])
@@ -55,22 +76,19 @@ def quantize_weight(w: jax.Array) -> dict:
     return {"q8": q8, "scale": scale}
 
 
-def quantize_params(params: dict, arch: ModelArch) -> dict:
+def quantize_params(params: dict) -> dict:
     """Quantize a serving param tree in place-shape (new tree).
 
-    Stacked layer weights ``[L, in, out]`` get per-(layer, out-channel)
-    scales.  Non-matmul leaves pass through untouched.
+    Every layer group's QUANT_KEYS quantize; non-matmul leaves and
+    top-level params (embed/lm_head/final_norm) pass through.
     """
-    if not supports_quantization(arch):
-        raise ValueError(
-            "int8 serving currently covers dense GQA families only "
-            f"(MLA or MoE layers present)")
     out = dict(params)
-    for group in ("dense",):
-        stack = dict(params[group])
+    for group, sub in params.items():
+        if not isinstance(sub, dict) or group == "serve_lora":
+            continue
+        stack = dict(sub)
         for key in QUANT_KEYS:
-            if key in stack:
+            if key in stack and not is_qtensor(stack[key]):
                 stack[key] = quantize_weight(stack[key])
         out[group] = stack
     return out
-

@@ -46,11 +46,77 @@ def test_stacked_layer_weights_quantize_per_layer():
                                np.asarray(solo["scale"]))
 
 
-def test_mla_and_moe_rejected():
-    mla = get_model_by_name("deepseek-v3-0324")
-    assert not supports_quantization(mla.arch)
-    with pytest.raises(ValueError):
-        quantize_params({}, mla.arch)
+def _close_logits_engine_pair(model_cfg: dict, prompt):
+    """int8 vs bf16 engines on the same synthetic weights: the first
+    (most peaked) greedy token must agree."""
+    from kaito_tpu.models.autogen import metadata_from_hf_config
+
+    md = metadata_from_hf_config("test/int8-family", model_cfg)
+    base = dict(max_num_seqs=2, max_model_len=256, dtype="float32",
+                kv_dtype="float32", enable_prefix_caching=False)
+    eng_q = InferenceEngine(EngineConfig(**base, quantization="int8"),
+                            metadata=md)
+    eng_f = InferenceEngine(EngineConfig(**base), metadata=md)
+    outs = []
+    for eng in (eng_q, eng_f):
+        req = eng.submit(prompt, SamplingParams(max_tokens=4,
+                                                temperature=0.0,
+                                                ignore_eos=True))
+        guard = 0
+        while not req.finish_reason and guard < 200:
+            eng.step()
+            guard += 1
+        assert len(req.output_tokens) == 4
+        outs.append(req.output_tokens)
+    return eng_q, outs
+
+
+def test_moe_engine_serves_int8():
+    """MoE expert stacks quantize (per-(layer, expert, out) scales) and
+    the ragged grouped-matmul path dequants on use."""
+    cfg = {
+        "architectures": ["MixtralForCausalLM"], "model_type": "mixtral",
+        "vocab_size": 512, "hidden_size": 64, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "num_key_value_heads": 2,
+        "intermediate_size": 128, "num_local_experts": 4,
+        "num_experts_per_tok": 2, "max_position_embeddings": 512,
+    }
+    eng_q, (q_out, f_out) = _close_logits_engine_pair(cfg, [5, 7, 11])
+    moe_group = next(g for g, sub in eng_q.params.items()
+                     if isinstance(sub, dict) and "experts_gate" in sub)
+    qt = eng_q.params[moe_group]["experts_gate"]
+    assert qt["q8"].dtype == jnp.int8
+    # scale is per-(layer, expert, out-channel)
+    assert qt["scale"].shape == qt["q8"].shape[:2] + qt["q8"].shape[-1:]
+    assert q_out[0] == f_out[0]
+    # router stays full precision (quality-critical, tiny)
+    assert not isinstance(eng_q.params[moe_group]["router"], dict)
+
+
+def test_mla_engine_serves_int8():
+    """MLA latent projections quantize; the absorbed kv_b expansion
+    matrices stay bf16 (they run inside the attention kernels)."""
+    cfg = {
+        "architectures": ["DeepseekV3ForCausalLM"],
+        "model_type": "deepseek_v3",
+        "vocab_size": 512, "hidden_size": 64, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "num_key_value_heads": 4,
+        "intermediate_size": 128, "max_position_embeddings": 512,
+        "kv_lora_rank": 32, "q_lora_rank": 48,
+        "qk_rope_head_dim": 16, "qk_nope_head_dim": 32, "v_head_dim": 32,
+        "n_routed_experts": 0, "num_experts_per_tok": 0,
+    }
+    eng_q, (q_out, f_out) = _close_logits_engine_pair(cfg, [3, 5, 7])
+    group = next(g for g, sub in eng_q.params.items()
+                 if isinstance(sub, dict) and "kv_a" in sub)
+    assert eng_q.params[group]["kv_a"]["q8"].dtype == jnp.int8
+    assert not isinstance(eng_q.params[group]["kv_b_k"], dict)
+    assert q_out[0] == f_out[0]
+
+
+def test_supports_quantization_every_family():
+    for name in ("deepseek-v3-0324", "gpt-oss-20b", "llama-3.1-8b-instruct"):
+        assert supports_quantization(get_model_by_name(name).arch)
 
 
 def test_engine_serves_int8_with_close_logits():
