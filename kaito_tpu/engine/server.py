@@ -105,6 +105,12 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             self.wfile.write(body)
         elif self.path.startswith("/pd/kv/"):
             self._pd_kv(self.path[len("/pd/kv/"):])
+        elif self.path in ("/ui", "/ui/"):
+            # single-pod demo: the DemoUI chat page served in-process
+            # (the standalone proxy pod lives in kaito_tpu/ui)
+            from kaito_tpu.ui import serve_page
+
+            serve_page(self)
         elif self.path == "/v1/models":
             models = [{"id": st.model_name, "object": "model",
                        "owned_by": "kaito-tpu", "root": st.model_name}]

@@ -1,0 +1,3 @@
+from kaito_tpu.ui import main
+
+main()
