@@ -209,6 +209,10 @@ class InferenceEngine:
             # quantize — an 8B-class bf16 tree (16 GiB) never has to be
             # resident at once on a 16 GiB chip
             self.params = self._init_quantized_params()
+        elif cfg.quantization and params is None:
+            # real checkpoint: the loader quantizes per tensor as it
+            # streams (_make_leaf_transform) — nothing left to do here
+            self.params = self._init_params()
         else:
             self.params = params if params is not None else self._init_params()
             if cfg.quantization:
@@ -482,24 +486,68 @@ class InferenceEngine:
             return NamedSharding(self.mesh, P(None, None, None, "tensor"))
         return NamedSharding(self.mesh, P())
 
+    def _make_leaf_transform(self):
+        """Per-tensor checkpoint-load hook (weights.assemble_params):
+        each stacked tensor lands straight on its mesh sharding and —
+        under --quantization — quantizes immediately with donation, so
+        peak HBM during a 70B int8 load is the int8 tree plus ONE bf16
+        stacked tensor (never the whole bf16 tree, and never a full
+        tensor on a single chip of the mesh)."""
+        from jax.sharding import NamedSharding
+
+        from kaito_tpu.engine.quant import is_quantized_leaf, quantize_weight
+        from kaito_tpu.parallel.sharding import SERVE_RULES
+
+        np_dtype = np.dtype(self.dtype)
+        quant = bool(self.cfg.quantization)
+        mesh = self.mesh
+        # ONE derivation of the target layouts (the same trees the
+        # synthetic/post-load paths use) — indexed per leaf below
+        weight_sh = self._param_shardings() if mesh is not None else None
+        qtensor_sh = (self._quantized_param_shardings()
+                      if quant and mesh is not None else None)
+        qfns: dict = {}   # out_shardings (or None) -> jitted quantizer
+
+        def transform(group: str, key: str, np_arr):
+            host = (np_arr if np_arr.dtype == np_dtype
+                    else np_arr.astype(np_dtype))
+            if mesh is not None:
+                sh = weight_sh[group][key] if group else weight_sh[key]
+                arr = jax.device_put(host, sh)
+            else:
+                arr = jnp.asarray(host)
+            if quant and group and is_quantized_leaf(group, key):
+                out_sh = (tuple(sorted(qtensor_sh[group][key].items()))
+                          if qtensor_sh is not None else None)
+                fn = qfns.get(out_sh)
+                if fn is None:
+                    kw = ({"out_shardings": dict(out_sh)}
+                          if out_sh is not None else {})
+                    fn = qfns[out_sh] = jax.jit(
+                        quantize_weight, donate_argnums=0, **kw)
+                arr = fn(arr)
+            return arr
+
+        return transform
+
     def _init_params(self):
         if self.cfg.weights_dir:
             wd = self.cfg.weights_dir
-            logger.info("loading checkpoint from %s", wd)
+            logger.info("loading checkpoint from %s%s", wd,
+                        " (int8 per-tensor quantize-on-load)"
+                        if self.cfg.quantization else "")
+            transform = self._make_leaf_transform()
             if wd.startswith(("gs://", "http://", "https://")):
                 # streaming load: per-tensor ranged reads, no local copy
                 from kaito_tpu.engine.streaming import (
                     stream_safetensors_params)
 
-                params = stream_safetensors_params(self.model, wd)
-            else:
-                from kaito_tpu.engine.weights import load_safetensors_params
+                return stream_safetensors_params(self.model, wd,
+                                                 leaf_transform=transform)
+            from kaito_tpu.engine.weights import load_safetensors_params
 
-                params = load_safetensors_params(self.model, wd)
-            if self.mesh is not None:
-                params = jax.tree.map(jax.device_put, params,
-                                      self._param_shardings())
-            return params
+            return load_safetensors_params(self.model, wd,
+                                           leaf_transform=transform)
         logger.info("initializing synthetic weights for %s (mesh=%s)",
                     self.md.name, self.mesh)
         t0 = time.monotonic()

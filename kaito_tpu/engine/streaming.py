@@ -214,8 +214,8 @@ class SafetensorsStream:
 
 
 def stream_safetensors_params(model, location: str,
-                              reader: Optional[HTTPRangeReader] = None
-                              ) -> dict:
+                              reader: Optional[HTTPRangeReader] = None,
+                              leaf_transform=None) -> dict:
     """Assemble the stacked param tree by streaming each tensor's byte
     span from the blob store — no staging copy (reference contract:
     modelstreaming.go SetStreamingConfig + runai_streamer)."""
@@ -224,7 +224,8 @@ def stream_safetensors_params(model, location: str,
     t0 = time.monotonic()
     reader = reader or make_reader(location)
     stream = SafetensorsStream(reader)
-    params = assemble_params(model, stream.read_tensor, stream.keys())
+    params = assemble_params(model, stream.read_tensor, stream.keys(),
+                             leaf_transform=leaf_transform)
     secs = time.monotonic() - t0
     # cold-start record, benchmark-probe style (driver/controller greppable)
     print("KAITO_WEIGHTS_STREAM_RESULT " + json.dumps({

@@ -72,21 +72,36 @@ def _reader(directory: str) -> tuple[Callable[[str], Optional[np.ndarray]], list
     return read, sorted(key_to_handle)
 
 
-def load_safetensors_params(model: TransformerLM, directory: str) -> dict:
+def load_safetensors_params(model: TransformerLM, directory: str,
+                            leaf_transform=None) -> dict:
     """Assemble the stacked param tree from HF shards on disk."""
     read, all_keys = _reader(directory)
-    params = assemble_params(model, read, all_keys)
+    params = assemble_params(model, read, all_keys,
+                             leaf_transform=leaf_transform)
     logger.info("loaded %d stacked tensors from %s", len(all_keys), directory)
     return params
 
 
 def assemble_params(model: TransformerLM,
                     read: Callable[[str], Optional[np.ndarray]],
-                    all_keys: list[str]) -> dict:
+                    all_keys: list[str],
+                    leaf_transform=None) -> dict:
     """Map HF tensors (via any reader — disk shards or ranged streaming)
-    onto the scan-stacked layout."""
+    onto the scan-stacked layout.
+
+    ``leaf_transform(group, key, np_array) -> device leaf`` (group ""
+    for top-level params) replaces the default ``jnp.asarray``
+    placement per assembled tensor — the engine uses it to shard each
+    stacked tensor straight onto its mesh and quantize it immediately
+    (donated), so a 70B int8 load never materializes the bf16 tree.
+    """
     arch = model.arch
     dtype = model.dtype
+
+    def put(group: str, key: str, np_arr: np.ndarray):
+        if leaf_transform is not None:
+            return leaf_transform(group, key, np.asarray(np_arr))
+        return jnp.asarray(np_arr, dtype)
 
     def get(name: str, required: bool = True) -> Optional[np.ndarray]:
         for prefix in ("model.", "transformer.", ""):
@@ -103,11 +118,11 @@ def assemble_params(model: TransformerLM,
     if pad > 0:
         embed = np.concatenate([embed, np.zeros((pad, embed.shape[1]),
                                                 embed.dtype)])
-    params["embed"] = jnp.asarray(embed, dtype)
-    params["final_norm"] = jnp.asarray(get("norm.weight"), dtype)
+    params["embed"] = put("", "embed", embed)
+    params["final_norm"] = put("", "final_norm", get("norm.weight"))
     fnb = get("norm.bias", required=False)
     if fnb is not None:
-        params["final_norm_bias"] = jnp.asarray(fnb, dtype)
+        params["final_norm_bias"] = put("", "final_norm_bias", fnb)
     if not arch.tie_word_embeddings:
         head = read("lm_head.weight")
         if head is None:
@@ -116,7 +131,7 @@ def assemble_params(model: TransformerLM,
             head = np.concatenate([
                 head, np.zeros((model.vocab_padded - head.shape[0],
                                 head.shape[1]), head.dtype)])
-        params["lm_head"] = jnp.asarray(head, dtype)
+        params["lm_head"] = put("", "lm_head", head)
 
     layer_map = dict(_LAYER_MAP)
     if arch.pre_post_norm:
@@ -166,7 +181,7 @@ def assemble_params(model: TransformerLM,
                         f"no source tensor for layer {li} key {our_key!r}")
                 stack.setdefault(our_key, []).append(np.asarray(tensor))
         params[g.name] = {
-            k: jnp.asarray(np.stack(v), dtype) for k, v in stack.items()}
+            k: put(g.name, k, np.stack(v)) for k, v in stack.items()}
     return params
 
 

@@ -134,6 +134,26 @@ def _make_adapter(path, seed, scale=0.5, r=4):
     save_adapter(str(path), params, LoraConfig(r=r), "tiny-llama-test")
 
 
+def test_checkpoint_load_under_tp_matches_single(tmp_path):
+    """Checkpoint loading now shards each stacked tensor straight onto
+    the mesh (per-tensor leaf_transform): a tp=2 engine loading from
+    disk must match the single-device engine loading the same file."""
+    from safetensors.numpy import save_file
+
+    from kaito_tpu.engine.weights import export_hf_state_dict
+
+    model = TransformerLM(TINY, dtype=jnp.float32)
+    params = jax.jit(model.init_params)(jax.random.PRNGKey(11))
+    save_file(export_hf_state_dict(model, params),
+              str(tmp_path / "model.safetensors"))
+    cfg = dict(BASE, weights_dir=str(tmp_path))
+    _, ref = _run_one(EngineConfig(**cfg), [5, 6, 7, 8])
+    tp_eng, out = _run_one(EngineConfig(**cfg, tensor_parallel=2),
+                           [5, 6, 7, 8])
+    assert out == ref
+    assert len(tp_eng.params["dense"]["q"].sharding.device_set) == 2
+
+
 def test_per_request_lora_under_tp(tmp_path):
     """Stacked per-request adapters route by name on a tp=2 engine (no
     merge-into-base fallback) with single-device parity."""

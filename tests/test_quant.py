@@ -119,6 +119,49 @@ def test_supports_quantization_every_family():
         assert supports_quantization(get_model_by_name(name).arch)
 
 
+def test_quantize_on_load_matches_post_load_quantize(tmp_path):
+    """A real checkpoint with --quantization quantizes PER TENSOR as it
+    loads (the bf16 tree never materializes); the result must be
+    bit-identical to load-then-quantize."""
+    from safetensors.numpy import save_file
+
+    from kaito_tpu.engine.model import TransformerLM
+    from kaito_tpu.engine.weights import export_hf_state_dict
+
+    md = get_model_by_name("tiny-llama-test")
+    model = TransformerLM(md.arch, dtype=jnp.float32)
+    params = jax.jit(model.init_params)(jax.random.PRNGKey(3))
+    save_file(export_hf_state_dict(model, params),
+              str(tmp_path / "model.safetensors"))
+
+    base = dict(model="tiny-llama-test", max_num_seqs=2, max_model_len=128,
+                dtype="float32", kv_dtype="float32",
+                enable_prefix_caching=False, weights_dir=str(tmp_path))
+    eng = InferenceEngine(EngineConfig(**base, quantization="int8"))
+    assert eng.params["dense"]["q"]["q8"].dtype == jnp.int8
+
+    from kaito_tpu.engine.weights import load_safetensors_params
+
+    ref = jax.jit(quantize_params)(
+        load_safetensors_params(model, str(tmp_path)))
+    np.testing.assert_array_equal(
+        np.asarray(eng.params["dense"]["q"]["q8"]),
+        np.asarray(ref["dense"]["q"]["q8"]))
+    np.testing.assert_allclose(
+        np.asarray(eng.params["dense"]["down"]["scale"]),
+        np.asarray(ref["dense"]["down"]["scale"]), rtol=1e-6)
+
+    # and the quantized engine actually decodes from the checkpoint
+    req = eng.submit([5, 7, 9], SamplingParams(max_tokens=4,
+                                               temperature=0.0,
+                                               ignore_eos=True))
+    for _ in range(100):
+        eng.step()
+        if req.finish_reason:
+            break
+    assert len(req.output_tokens) == 4
+
+
 def test_engine_serves_int8_with_close_logits():
     """A quantized engine decodes greedily end to end, and its first
     step's choice agrees with bf16 for a clearly-peaked distribution."""
