@@ -86,7 +86,24 @@ class TransformerLM:
             self._inv_freq_global = nn.rope_frequencies(rope_arch)
         else:
             self._inv_freq_global = nn.rope_frequencies(arch)
+        # attention_factor only reads rope_scaling/max_pos, which the
+        # MLA rope_arch replace() leaves untouched
+        self._rope_mscale = nn.rope_attention_factor(arch)
+        # longrope (phi-3 family): per-position short/long table switch
+        self._longrope = None if self.is_mla else nn.longrope_tables(arch)
         self._inv_freq_local = self._make_inv_freq_local()
+
+    def _rope_select(self, positions):
+        """(inv_freq, mscale) for the global table — per-position
+        short/long selection when the arch is longrope (positions past
+        the original trained length use the long factors)."""
+        if self._longrope is None:
+            return self._inv_freq_global, self._rope_mscale
+        short, long, orig, short_m, long_m = self._longrope
+        mask = positions >= orig                       # [..., seq]
+        inv = jnp.where(mask[..., None], long, short)  # [..., seq, half]
+        ms = jnp.where(mask[..., None, None], long_m, short_m)
+        return inv, ms
 
     # ------------------------------------------------------------------
     # Parameter construction
@@ -256,8 +273,18 @@ class TransformerLM:
     def _scale(self) -> float:
         a = self.arch
         if self.is_mla:
-            return 1.0 / math.sqrt((a.qk_nope_head_dim or a.head_dim)
+            base = 1.0 / math.sqrt((a.qk_nope_head_dim or a.head_dim)
                                    + (a.qk_rope_head_dim or 0))
+            # deepseek-yarn: the all-dim mscale lands in the softmax
+            # scale (squared — applied to both q and k), while the
+            # mscale/mscale_all_dim RATIO rides the rope table
+            s = a.rope_scaling or {}
+            stype = str(s.get("rope_type", s.get("type", ""))).lower()
+            if stype == "yarn" and s.get("mscale_all_dim") is not None:
+                m = nn.yarn_get_mscale(float(s.get("factor", 1.0)),
+                                       float(s["mscale_all_dim"]))
+                base *= m * m
+            return base
         denom = a.query_pre_attn_scalar if a.query_pre_attn_scalar else a.head_dim
         return 1.0 / math.sqrt(denom)
 
@@ -289,12 +316,14 @@ class TransformerLM:
             q = nn.linear(h, p["q"])
         q = q.reshape(B, T, H, dn + dr)
         q_nope, q_rope = q[..., :dn], q[..., dn:]
-        q_rope = nn.apply_rope(q_rope, positions, self._inv_freq_global, dr)
+        q_rope = nn.apply_rope(q_rope, positions, self._inv_freq_global, dr,
+                               mscale=self._rope_mscale)
 
         kv = nn.linear(h, p["kv_a"])             # [B, T, dl+dr]
         c_kv = nn.rms_norm(kv[..., :dl], p["kv_a_norm"], a.rms_norm_eps, False)
         k_rope = nn.apply_rope(kv[..., dl:][:, :, None, :], positions,
-                               self._inv_freq_global, dr)[:, :, 0]
+                               self._inv_freq_global, dr,
+                               mscale=self._rope_mscale)[:, :, 0]
         latent = jnp.concatenate([c_kv, k_rope], axis=-1)  # [B, T, dl+dr]
 
         if mode == "train":
@@ -359,12 +388,17 @@ class TransformerLM:
             q = nn.rms_norm(q, p["q_norm"], a.rms_norm_eps, a.norm_offset)
             k = nn.rms_norm(k, p["k_norm"], a.rms_norm_eps, a.norm_offset)
         if window is None or self._inv_freq_local is self._inv_freq_global:
-            inv_freq = self._inv_freq_global
+            inv_freq, mscale = self._rope_select(positions)
         else:
+            # sliding-window mix (gemma-3): local layers use the
+            # unscaled 10k table with no magnitude correction (no
+            # supported arch mixes sliding windows with longrope)
             inv_freq = jnp.where(window >= _BIG_WINDOW,
                                  self._inv_freq_global, self._inv_freq_local)
-        q = nn.apply_rope(q, positions, inv_freq, a.head_dim)
-        k = nn.apply_rope(k, positions, inv_freq, a.head_dim)
+            mscale = jnp.where(window >= _BIG_WINDOW,
+                               self._rope_mscale, 1.0)
+        q = nn.apply_rope(q, positions, inv_freq, a.head_dim, mscale=mscale)
+        k = nn.apply_rope(k, positions, inv_freq, a.head_dim, mscale=mscale)
         return q, k, v
 
     def _mlp(self, x: jax.Array, p: dict, moe: bool,

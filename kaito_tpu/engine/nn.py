@@ -93,8 +93,22 @@ def apply_norm(x: jax.Array, params: dict, arch: ModelArch) -> jax.Array:
 # Rotary position embedding (with llama3 / linear / yarn-style scaling)
 # ---------------------------------------------------------------------------
 
+def _yarn_find_correction_dim(num_rotations: float, dim: int, base: float,
+                              max_pos: float) -> float:
+    return (dim * math.log(max_pos / (num_rotations * 2 * math.pi))
+            ) / (2 * math.log(base))
+
+
+def yarn_get_mscale(scale: float, mscale: float = 1.0) -> float:
+    """YaRN attention-magnitude correction (0.1·m·ln(s)+1)."""
+    if scale <= 1.0:
+        return 1.0
+    return 0.1 * mscale * math.log(scale) + 1.0
+
+
 def rope_frequencies(arch: ModelArch) -> jax.Array:
-    """Per-pair inverse frequencies, with rope_scaling applied."""
+    """Per-pair inverse frequencies, with rope_scaling applied
+    (exact llama3 / yarn NTK-by-parts / longrope per-dim factors)."""
     rot_dim = int(arch.head_dim * arch.partial_rotary_factor)
     rot_dim -= rot_dim % 2
     exponent = jnp.arange(0, rot_dim, 2, dtype=jnp.float32) / rot_dim
@@ -123,24 +137,120 @@ def rope_frequencies(arch: ModelArch) -> jax.Array:
                       (1 - smooth) * inv_freq / factor + smooth * inv_freq),
         )
         inv_freq = scaled
-    elif rope_type in ("yarn", "longrope"):
-        # Serving-grade approximation: plain NTK-by-parts is replaced by
-        # uniform interpolation at the trained factor; exact yarn ramps
-        # land with the long-context milestone.
-        inv_freq = inv_freq / float(scaling.get("factor", 1.0))
+    elif rope_type == "yarn":
+        # exact NTK-by-parts: high-frequency pairs keep the base table
+        # (extrapolation), low-frequency pairs interpolate by `factor`,
+        # with a linear ramp between the beta_fast/beta_slow correction
+        # dims (the deepseek / HF YarnRotaryEmbedding recipe)
+        factor = float(scaling.get("factor", 1.0))
+        orig = float(scaling.get("original_max_position_embeddings",
+                                 arch.max_position_embeddings))
+        beta_fast = float(scaling.get("beta_fast", 32.0))
+        beta_slow = float(scaling.get("beta_slow", 1.0))
+        low = math.floor(_yarn_find_correction_dim(
+            beta_fast, rot_dim, arch.rope_theta, orig))
+        high = math.ceil(_yarn_find_correction_dim(
+            beta_slow, rot_dim, arch.rope_theta, orig))
+        low, high = max(low, 0), min(high, rot_dim - 1)
+        if low == high:
+            high += 0.001
+        ramp = jnp.clip(
+            (jnp.arange(rot_dim // 2, dtype=jnp.float32) - low)
+            / (high - low), 0.0, 1.0)
+        extrap_mask = 1.0 - ramp
+        inv_freq = (inv_freq / factor) * (1.0 - extrap_mask) \
+            + inv_freq * extrap_mask
+    elif rope_type in ("longrope", "su"):
+        # phi-3 family: per-dim rescale factors, long vs short chosen
+        # by whether the model runs past its original trained length
+        orig = float(scaling.get("original_max_position_embeddings",
+                                 arch.max_position_embeddings))
+        use_long = arch.max_position_embeddings > orig
+        factors = scaling.get("long_factor" if use_long else "short_factor")
+        if factors is not None:
+            f = jnp.asarray(factors, jnp.float32)[: rot_dim // 2]
+            inv_freq = inv_freq / f
+        else:
+            inv_freq = inv_freq / float(scaling.get("factor", 1.0))
     return inv_freq
 
 
+def longrope_tables(arch: ModelArch):
+    """Per-position longrope state for archs carrying factor lists:
+    ``(short_inv_freq, long_inv_freq, orig_len, short_mscale,
+    long_mscale)``; None otherwise.
+
+    The serving engine switches tables PER POSITION (positions past the
+    original trained length use long factors) — the vLLM
+    Phi3LongRoPE cache semantics, which HF's per-forward seq-len switch
+    approximates; a batch mixing short and long sequences gets each
+    row's correct table.
+    """
+    scaling = arch.rope_scaling or {}
+    rope_type = str(scaling.get("rope_type", scaling.get("type", ""))).lower()
+    if rope_type not in ("longrope", "su") or "long_factor" not in scaling:
+        return None
+    rot_dim = int(arch.head_dim * arch.partial_rotary_factor)
+    rot_dim -= rot_dim % 2
+    exponent = jnp.arange(0, rot_dim, 2, dtype=jnp.float32) / rot_dim
+    base = 1.0 / (arch.rope_theta ** exponent)
+    half = rot_dim // 2
+    short = base / jnp.asarray(scaling.get("short_factor"),
+                               jnp.float32)[:half]
+    long = base / jnp.asarray(scaling.get("long_factor"),
+                              jnp.float32)[:half]
+    orig = float(scaling.get("original_max_position_embeddings",
+                             arch.max_position_embeddings))
+    s = arch.max_position_embeddings / orig
+    default_m = (math.sqrt(1.0 + math.log(s) / math.log(orig))
+                 if s > 1.0 else 1.0)
+    short_m = float(scaling.get("short_mscale") or default_m)
+    long_m = float(scaling.get("long_mscale") or default_m)
+    return short, long, orig, short_m, long_m
+
+
+def rope_attention_factor(arch: ModelArch) -> float:
+    """Magnitude correction multiplying the ROTATED dims' cos/sin (the
+    HF attention_scaling contract): yarn's mscale (or the
+    mscale/mscale_all_dim ratio when both are set — deepseek style,
+    where the all-dim part moves into the softmax scale instead), and
+    longrope's sqrt(1 + ln(s)/ln(orig))."""
+    scaling = arch.rope_scaling or {}
+    rope_type = str(scaling.get("rope_type", scaling.get("type", ""))).lower()
+    if scaling.get("attention_factor") is not None:
+        return float(scaling["attention_factor"])
+    if rope_type == "yarn":
+        factor = float(scaling.get("factor", 1.0))
+        mscale = float(scaling.get("mscale", 1.0))
+        mad = scaling.get("mscale_all_dim")
+        if mad is not None:
+            return yarn_get_mscale(factor, mscale) \
+                / yarn_get_mscale(factor, float(mad))
+        return yarn_get_mscale(factor, mscale)
+    if rope_type in ("longrope", "su"):
+        orig = float(scaling.get("original_max_position_embeddings",
+                                 arch.max_position_embeddings))
+        s = arch.max_position_embeddings / orig
+        if s <= 1.0:
+            return 1.0
+        return math.sqrt(1.0 + math.log(s) / math.log(orig))
+    return 1.0
+
+
 def apply_rope(x: jax.Array, positions: jax.Array, inv_freq: jax.Array,
-               head_dim: int) -> jax.Array:
+               head_dim: int, mscale=1.0) -> jax.Array:
     """Rotate the first ``2*len(inv_freq)`` dims of each head.
 
-    x: [..., seq, heads, head_dim]; positions: [..., seq].
+    x: [..., seq, heads, head_dim]; positions: [..., seq].  ``mscale``
+    multiplies the rotated output (HF's attention_scaling on cos/sin —
+    yarn/longrope magnitude correction); pass-through dims unscaled.
+    ``inv_freq`` may be per-position ([..., seq, half] — the longrope
+    short/long switch) or a plain [half] table.
     """
-    rot = 2 * inv_freq.shape[0]
+    rot = 2 * inv_freq.shape[-1]
     angles = positions[..., :, None].astype(jnp.float32) * inv_freq  # [..., seq, rot/2]
-    cos = jnp.cos(angles)[..., :, None, :]
-    sin = jnp.sin(angles)[..., :, None, :]
+    cos = jnp.cos(angles)[..., :, None, :] * mscale
+    sin = jnp.sin(angles)[..., :, None, :] * mscale
     x_rot = x[..., :rot].astype(jnp.float32)
     x_pass = x[..., rot:]
     x1, x2 = jnp.split(x_rot, 2, axis=-1)

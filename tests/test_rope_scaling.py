@@ -1,0 +1,179 @@
+"""Exact rope-scaling math: yarn NTK-by-parts, longrope per-dim
+factors, and the magnitude corrections — checked against independent
+re-implementations of the published formulas (HF Yarn/LongRoPE
+rotary-embedding recipes; deepseek's softmax mscale)."""
+
+import math
+from dataclasses import replace
+
+import jax.numpy as jnp
+import numpy as np
+
+from kaito_tpu.engine.nn import (
+    rope_attention_factor,
+    rope_frequencies,
+    yarn_get_mscale,
+)
+from kaito_tpu.models.autogen import arch_from_hf_config
+
+BASE_CFG = {
+    "architectures": ["LlamaForCausalLM"], "model_type": "llama",
+    "vocab_size": 512, "hidden_size": 256, "num_hidden_layers": 2,
+    "num_attention_heads": 4, "num_key_value_heads": 2,
+    "intermediate_size": 512, "max_position_embeddings": 131072,
+    "rope_theta": 10000.0,
+}
+
+
+def _arch(scaling, max_pos=131072):
+    return arch_from_hf_config({**BASE_CFG, "rope_scaling": scaling,
+                                "max_position_embeddings": max_pos})
+
+
+def _reference_yarn(dim, base, factor, orig, beta_fast=32.0, beta_slow=1.0):
+    """Independent NTK-by-parts implementation (HF recipe)."""
+    pos_freqs = base ** (np.arange(0, dim, 2, dtype=np.float64) / dim)
+    extrap = 1.0 / pos_freqs
+    interp = 1.0 / (factor * pos_freqs)
+
+    def corr_dim(n_rot):
+        return (dim * math.log(orig / (n_rot * 2 * math.pi))
+                ) / (2 * math.log(base))
+
+    low = max(math.floor(corr_dim(beta_fast)), 0)
+    high = min(math.ceil(corr_dim(beta_slow)), dim - 1)
+    if low == high:
+        high += 0.001
+    ramp = np.clip((np.arange(dim // 2, dtype=np.float64) - low)
+                   / (high - low), 0, 1)
+    extrap_mask = 1 - ramp
+    return interp * (1 - extrap_mask) + extrap * extrap_mask
+
+
+def test_yarn_matches_reference_recipe():
+    scaling = {"rope_type": "yarn", "factor": 40.0,
+               "original_max_position_embeddings": 4096,
+               "beta_fast": 32, "beta_slow": 1}
+    got = np.asarray(rope_frequencies(_arch(scaling)), np.float64)
+    want = _reference_yarn(64, 10000.0, 40.0, 4096)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    # high-frequency (extrapolated) pairs keep the base table; the
+    # lowest-frequency pair is fully interpolated
+    base = 1.0 / (10000.0 ** (np.arange(0, 64, 2) / 64))
+    np.testing.assert_allclose(got[0], base[0], rtol=1e-6)
+    np.testing.assert_allclose(got[-1], base[-1] / 40.0, rtol=1e-4)
+
+
+def test_yarn_attention_factor_and_mscale():
+    plain = {"rope_type": "yarn", "factor": 40.0}
+    assert rope_attention_factor(_arch(plain)) == \
+        yarn_get_mscale(40.0)       # 0.1*ln(40)+1
+    # deepseek style: equal mscale/mscale_all_dim -> table factor 1,
+    # softmax gets the all-dim correction instead
+    ds = {"rope_type": "yarn", "factor": 40.0, "mscale": 1.0,
+          "mscale_all_dim": 1.0}
+    assert rope_attention_factor(_arch(ds)) == 1.0
+    assert yarn_get_mscale(40.0, 1.0) > 1.3
+
+
+def test_mla_softmax_scale_carries_mscale_squared():
+    from kaito_tpu.engine.model import TransformerLM
+
+    cfg = {
+        "architectures": ["DeepseekV3ForCausalLM"],
+        "model_type": "deepseek_v3",
+        "vocab_size": 512, "hidden_size": 64, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "num_key_value_heads": 4,
+        "intermediate_size": 128, "max_position_embeddings": 131072,
+        "kv_lora_rank": 32, "qk_rope_head_dim": 16,
+        "qk_nope_head_dim": 32, "v_head_dim": 32,
+        "n_routed_experts": 0, "num_experts_per_tok": 0,
+        "rope_scaling": {"type": "yarn", "factor": 40.0, "mscale": 1.0,
+                         "mscale_all_dim": 1.0,
+                         "original_max_position_embeddings": 4096},
+    }
+    model = TransformerLM(arch_from_hf_config(cfg), dtype=jnp.float32)
+    m = yarn_get_mscale(40.0, 1.0)
+    want = (1.0 / math.sqrt(32 + 16)) * m * m
+    assert abs(model._scale - want) < 1e-9
+    assert model._rope_mscale == 1.0    # ratio form: table unscaled
+
+
+def test_longrope_per_dim_factors_and_selection():
+    half = 32
+    long_f = [2.0 + i * 0.1 for i in range(half)]
+    short_f = [1.0] * half
+    scaling = {"rope_type": "longrope", "long_factor": long_f,
+               "short_factor": short_f,
+               "original_max_position_embeddings": 4096}
+    base = 1.0 / (10000.0 ** (np.arange(0, 64, 2) / 64))
+    # running past the original length -> long factors divide per dim
+    got_long = np.asarray(rope_frequencies(_arch(scaling, 131072)))
+    np.testing.assert_allclose(got_long, base / np.asarray(long_f),
+                               rtol=1e-5)
+    # within the original length -> short factors (identity here)
+    got_short = np.asarray(rope_frequencies(_arch(scaling, 4096)))
+    np.testing.assert_allclose(got_short, base, rtol=1e-5)
+    # phi-3 magnitude correction: sqrt(1 + ln(s)/ln(orig))
+    s = 131072 / 4096
+    want = math.sqrt(1.0 + math.log(s) / math.log(4096))
+    assert abs(rope_attention_factor(_arch(scaling, 131072)) - want) < 1e-9
+    assert rope_attention_factor(_arch(scaling, 4096)) == 1.0
+
+
+def test_longrope_per_position_switch():
+    """vLLM-style cache semantics: positions before the original
+    trained length use short factors, positions past it use long —
+    WITHIN one sequence/batch (HF's per-forward switch approximates
+    this; a serving batch mixes both regimes)."""
+    from kaito_tpu.engine.model import TransformerLM
+
+    half = 32
+    scaling = {"rope_type": "longrope",
+               "long_factor": [2.0] * half, "short_factor": [1.0] * half,
+               "original_max_position_embeddings": 4096}
+    model = TransformerLM(_arch(scaling, 131072), dtype=jnp.float32)
+    assert model._longrope is not None
+    positions = jnp.asarray([[0, 4095, 4096, 10000]], jnp.int32)
+    inv, ms = model._rope_select(positions)
+    base = 1.0 / (10000.0 ** (np.arange(0, 64, 2) / 64))
+    got = np.asarray(inv)[0]
+    np.testing.assert_allclose(got[0], base, rtol=1e-5)        # short
+    np.testing.assert_allclose(got[1], base, rtol=1e-5)        # short
+    np.testing.assert_allclose(got[2], base / 2.0, rtol=1e-5)  # long
+    np.testing.assert_allclose(got[3], base / 2.0, rtol=1e-5)
+    assert np.asarray(ms).shape == (1, 4, 1, 1)
+
+
+def test_phi3_128k_preset_decode_consistency():
+    """The longrope preset family still decodes consistently end to
+    end (prefill vs decode agreement exercises the scaled tables)."""
+    from kaito_tpu.engine.config import EngineConfig
+    from kaito_tpu.engine.engine import InferenceEngine, SamplingParams
+    from kaito_tpu.models.autogen import metadata_from_hf_config
+
+    half = 16   # head_dim 32 -> 16 pairs
+    cfg = {
+        "architectures": ["Phi3ForCausalLM"], "model_type": "phi3",
+        "vocab_size": 512, "hidden_size": 128, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "num_key_value_heads": 4,
+        "intermediate_size": 256, "max_position_embeddings": 8192,
+        "rope_scaling": {"type": "longrope",
+                         "long_factor": [1.5] * half,
+                         "short_factor": [1.0] * half,
+                         "original_max_position_embeddings": 2048},
+    }
+    md = metadata_from_hf_config("test/phi3-longrope", cfg)
+    eng = InferenceEngine(EngineConfig(
+        model="x", max_model_len=256, page_size=16, max_num_seqs=2,
+        dtype="float32", kv_dtype="float32", prefill_buckets=(32, 64),
+        enable_prefix_caching=False), metadata=md)
+    assert eng.model._longrope is not None
+    assert eng.model._longrope[4] > 1.0      # long_mscale from sqrt formula
+    eng.start()
+    try:
+        out = list(eng.submit([3, 5, 7], SamplingParams(
+            max_tokens=6, temperature=0.0, ignore_eos=True)).stream())
+    finally:
+        eng.stop()
+    assert len(out) == 6
