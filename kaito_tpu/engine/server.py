@@ -418,6 +418,68 @@ def make_server(engine: InferenceEngine, cfg: EngineConfig,
     return server
 
 
+class _LoadingHandler(BaseHTTPRequestHandler):
+    """Pre-engine stub: answers probes while weights load/compile.
+
+    The reference wrapper serves a /metrics stub + download progress
+    BEFORE vLLM is up (inference_api.py:265-415) so Prometheus scrapes
+    and kubelet probes don't read as failures during multi-minute model
+    loads; same contract here — /health returns 503 "loading" (startup
+    probes keep waiting instead of flapping) and /metrics exposes a
+    loading gauge.
+    """
+
+    protocol_version = "HTTP/1.1"
+    started: float = 0.0   # stamped by start_loading_stub's subclass
+
+    def log_message(self, *a):
+        pass
+
+    def _body(self, code, body, ctype):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path == "/health":
+            self._body(503, json.dumps(
+                {"status": "loading",
+                 "seconds": round(time.time() - self.started, 1)}).encode(),
+                "application/json")
+        elif self.path == "/metrics":
+            body = ("# HELP kaito:engine_loading 1 while weights "
+                    "load/compile\n# TYPE kaito:engine_loading gauge\n"
+                    f"kaito:engine_loading 1\n"
+                    f"kaito:engine_loading_seconds "
+                    f"{time.time() - self.started:.1f}\n").encode()
+            self._body(200, body, "text/plain; version=0.0.4")
+        else:
+            self._body(503, b'{"error": "engine loading"}',
+                       "application/json")
+
+    def do_POST(self):
+        # drain the body: an unread POST payload would desync the next
+        # request on a keep-alive connection
+        n = int(self.headers.get("Content-Length", "0") or 0)
+        if n:
+            self.rfile.read(n)
+        self._body(503, b'{"error": {"message": "engine loading", '
+                        b'"type": "unavailable"}}', "application/json")
+
+
+def start_loading_stub(host: str, port: int) -> ThreadingHTTPServer:
+    """Serve the loading stub until the engine is constructed; caller
+    shuts it down right before binding the real server."""
+    handler = type("LoadingHandler", (_LoadingHandler,),
+                   {"started": time.time()})
+    stub = ThreadingHTTPServer((host, port), handler)
+    threading.Thread(target=stub.serve_forever, daemon=True,
+                     name="loading-stub").start()
+    return stub
+
+
 def load_config_file(cfg: EngineConfig, path: str) -> EngineConfig:
     """Merge a KAITO config YAML over the engine config (same mechanism
     as the reference's --kaito-config-file: user YAML from the Workspace
@@ -527,6 +589,17 @@ def main(argv=None):
         cfg = load_config_file(cfg, args.kaito_config_file)
 
     logging.basicConfig(level=logging.INFO)
+    # probes/Prometheus must not flap during the minutes-long weight
+    # load + compile: serve a loading stub on the real port until the
+    # engine exists (reference inference_api.py:265-415)
+    stub = None
+    if jax.process_index() == 0:
+        try:
+            stub = start_loading_stub(args.host, cfg.port)
+        except OSError:
+            logger.warning("loading stub could not bind %s:%d; probes "
+                           "will see connection refused during load",
+                           args.host, cfg.port)
     if "/" in cfg.model:
         # auto-generated presets render the FULL org/model id into
         # --model; the pod resolves it the same way the controller did
@@ -559,6 +632,9 @@ def main(argv=None):
     else:
         engine = InferenceEngine(cfg)
         engine.start()
+    if stub is not None:
+        stub.shutdown()
+        stub.server_close()
     server = make_server(engine, cfg, host=args.host)
     logger.info("serving %s on %s:%d", cfg.model, args.host, cfg.port)
     try:

@@ -154,3 +154,47 @@ def test_adapter_discovery(tmp_path):
     (tmp_path / "not-adapter").mkdir()
     found = discover_adapters(str(tmp_path))
     assert list(found) == ["style-a"]
+
+
+def test_loading_stub_answers_probes_then_hands_over():
+    """Before the engine exists, the stub answers /health 503-loading
+    and /metrics with a loading gauge (reference: the pre-download
+    metrics stub, inference_api.py:265-415); the real server then binds
+    the same port."""
+    from kaito_tpu.engine.server import start_loading_stub
+
+    stub = start_loading_stub("127.0.0.1", 0)
+    port = stub.server_address[1]
+    url = f"http://127.0.0.1:{port}"
+    try:
+        try:
+            _get(url, "/health")
+            assert False, "expected 503"
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            assert json.loads(e.read())["status"] == "loading"
+        metrics = _get(url, "/metrics").read().decode()
+        assert "kaito:engine_loading 1" in metrics
+        try:
+            _post(url, "/v1/completions", {"prompt": "x"})
+            assert False, "expected 503"
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+    finally:
+        stub.shutdown()
+        stub.server_close()
+
+    # the real server binds the same port immediately after
+    cfg = EngineConfig(model="tiny-llama-test", max_model_len=128,
+                       page_size=16, max_num_seqs=2, dtype="float32",
+                       kv_dtype="float32", prefill_buckets=(32,))
+    engine = InferenceEngine(cfg)
+    engine.start()
+    server = make_server(engine, cfg, host="127.0.0.1", port=port)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        assert json.loads(_get(url, "/health").read())["status"] == "ok"
+    finally:
+        server.shutdown()
+        engine.stop()
