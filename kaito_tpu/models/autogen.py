@@ -151,6 +151,56 @@ def arch_from_hf_config(cfg: Mapping) -> ModelArch:
     return ModelArch(**kw)
 
 
+# Parser-mode derivation for generated presets (the reference's
+# reasoning/tool maps, generator.go:45-160, restricted to families this
+# engine serves).  The engine's chat route gates think-tag reasoning
+# splitting on reasoning_parser; tool extraction is format-sniffing
+# (hermes/mistral), with the parser NAME carried for contract parity.
+_REASONING_BY_PREFIX = {
+    "deepseek-r1": "deepseek_r1",
+    "qwq-32b": "deepseek_r1",
+    "deepseek-v3": "deepseek_v3",
+    "qwen3": "qwen3",
+}
+_REASONING_BY_ARCH = {
+    "DeepseekV3ForCausalLM": "deepseek_v3",
+    "Qwen3ForCausalLM": "qwen3",
+    "GptOssForCausalLM": "openai_gptoss",
+}
+_TOOLS_BY_PREFIX = {
+    "deepseek-r1": "deepseek_v3",
+    "deepseek-v3": "deepseek_v3",
+    "mistral": "mistral",
+    "ministral": "mistral",
+    "qwen2.5": "hermes",
+    "qwen3": "hermes",
+    "phi-4-mini": "phi4_mini_json",
+    "llama-3": "llama3_json",
+    "meta-llama-3": "llama3_json",
+}
+_TOOLS_BY_ARCH = {
+    "MistralForCausalLM": "mistral",
+    "MixtralForCausalLM": "mistral",
+    "LlamaForCausalLM": "llama3_json",
+    "Qwen2ForCausalLM": "hermes",
+    "Qwen3ForCausalLM": "hermes",
+}
+
+
+def derive_parsers(name: str, archs) -> tuple[str, str]:
+    """(tool_call_parser, reasoning_parser) for a model, by name prefix
+    first (most specific), architecture fallback."""
+    low = name.lower()
+    tool = next((v for k, v in _TOOLS_BY_PREFIX.items()
+                 if low.startswith(k)), "")
+    reasoning = next((v for k, v in _REASONING_BY_PREFIX.items()
+                      if low.startswith(k)), "")
+    for a in archs or ():
+        tool = tool or _TOOLS_BY_ARCH.get(a, "")
+        reasoning = reasoning or _REASONING_BY_ARCH.get(a, "")
+    return tool, reasoning
+
+
 def metadata_from_hf_config(
     hf_id: str,
     cfg: Mapping,
@@ -173,8 +223,11 @@ def metadata_from_hf_config(
     quant = quantization or str(
         (cfg.get("quantization_config") or {}).get("quant_method", "")
     )
+    preset_name = name or hf_id.split("/")[-1].lower()
+    tool_parser, reasoning_parser = derive_parsers(
+        hf_id.split("/")[-1], archs)
     return ModelMetadata(
-        name=name or hf_id.split("/")[-1].lower(),
+        name=preset_name,
         hf_id=hf_id,
         arch=arch,
         model_file_bytes=model_file_bytes,
@@ -182,4 +235,6 @@ def metadata_from_hf_config(
         download_auth_required=download_auth_required,
         quantization=quant,
         tags=tags,
+        tool_call_parser=tool_parser,
+        reasoning_parser=reasoning_parser,
     )

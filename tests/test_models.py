@@ -148,3 +148,25 @@ def test_disk_storage_rounding():
     disk = md.disk_storage_bytes()
     assert disk % (10 * GiB) == 0
     assert disk >= int(md.file_bytes * 2.5)
+
+
+def test_parser_derivation_matches_reference_maps():
+    """Generated presets carry tool/reasoning parser modes (reference
+    generator.go:45-160); the chat route gates reasoning splitting on
+    the reasoning field."""
+    from kaito_tpu.models.registry import get_model_by_name
+
+    cases = {
+        "deepseek-r1-distill-llama-8b": ("deepseek_v3", "deepseek_r1"),
+        "qwen3-8b": ("hermes", "qwen3"),
+        "deepseek-v3-0324": ("deepseek_v3", "deepseek_v3"),
+        "gpt-oss-20b": ("", "openai_gptoss"),
+        "mistral-7b-instruct": ("mistral", ""),
+        "llama-3.1-8b-instruct": ("llama3_json", ""),
+        "phi-4-mini-instruct": ("phi4_mini_json", ""),
+        "falcon-7b": ("", ""),
+    }
+    for name, (tool, reasoning) in cases.items():
+        md = get_model_by_name(name)
+        assert md.tool_call_parser == tool, name
+        assert md.reasoning_parser == reasoning, name
