@@ -40,7 +40,7 @@ import numpy as np
 from kaito_tpu.engine.config import EngineConfig
 from kaito_tpu.engine.kv_cache import KVCache, create_kv_cache
 from kaito_tpu.engine.model import TransformerLM
-from kaito_tpu.engine.sampler import SamplingState, sample
+from kaito_tpu.engine.sampler import SamplingState, chosen_logprob, sample
 from kaito_tpu.engine.tokenizer import load_tokenizer
 from kaito_tpu.estimator.estimator import PER_CHIP_OVERHEAD_BYTES, HBM_UTILIZATION
 from kaito_tpu.models.metadata import ModelMetadata
@@ -62,6 +62,7 @@ class SamplingParams:
     stop_token_ids: tuple[int, ...] = ()
     seed: int = 0
     ignore_eos: bool = False
+    logprobs: bool = False     # per-generated-token log p (model dist)
 
 
 @dataclass
@@ -71,6 +72,8 @@ class Request:
     params: SamplingParams
     out: "queue.SimpleQueue[Optional[int]]" = field(default_factory=queue.SimpleQueue)
     output_tokens: list[int] = field(default_factory=list)
+    output_logprobs: list = field(default_factory=list)  # floats (None for
+    # tokens whose logits never existed locally, e.g. PD-imported firsts)
     # P/D disaggregation (kaito_tpu.engine.pd)
     export_kv: bool = False                # prefill role: stage KV on finish
     kv_import: Optional[tuple] = None      # decode role: (meta, payload, first_token)
@@ -648,7 +651,8 @@ class InferenceEngine:
                                              page_tables, active,
                                              adapter_ids=adapter_ids)
             next_tokens, sampling = sample(logits, sampling)
-            return cache, sampling, next_tokens
+            return cache, sampling, next_tokens, \
+                chosen_logprob(logits, next_tokens)
 
         return decode_step
 
@@ -672,18 +676,20 @@ class InferenceEngine:
                                              page_tables, act,
                                              adapter_ids=adapter_ids)
                 nxt, sampling = sample(logits, sampling)
+                lp = chosen_logprob(logits, nxt)
                 nxt = jnp.where(act, nxt, toks)
                 left = left - act.astype(jnp.int32)
                 # stop_ids is -1-padded, token ids are >= 0
                 hit = jnp.any(nxt[:, None] == stop_ids, axis=1)
                 act_next = act & ~hit & (left > 0)
                 pos = pos + act.astype(jnp.int32)
-                return (cache, sampling, nxt, pos, act_next, left), (nxt, act)
+                return (cache, sampling, nxt, pos, act_next, left), \
+                    (nxt, act, lp)
 
             carry = (cache, sampling, tokens, positions, active, steps_left)
-            (cache, sampling, *_), (toks, acts) = jax.lax.scan(
+            (cache, sampling, *_), (toks, acts, lps) = jax.lax.scan(
                 body, carry, None, length=K)
-            return cache, sampling, toks, acts
+            return cache, sampling, toks, acts, lps
 
         return decode_multi
 
@@ -1175,27 +1181,31 @@ class InferenceEngine:
                 self.counters["prompt_tokens_total"] += len(req.prompt_tokens)
                 req.prompt_counted = True
             slot.prefilling = False
-            first = self._sample_first(i, logits)
-            self._begin_decode(i, first, n)
+            first, first_lp = self._sample_first(i, logits)
+            self._begin_decode(i, first, n, first_lp=first_lp)
         return True
 
-    def _sample_first(self, slot_idx: int, logits) -> int:
+    def _sample_first(self, slot_idx: int, logits) -> tuple[int, float]:
         sub = SamplingState(
             temperature=self.sampling.temperature[slot_idx:slot_idx + 1],
             top_k=self.sampling.top_k[slot_idx:slot_idx + 1],
             top_p=self.sampling.top_p[slot_idx:slot_idx + 1],
             key=self.sampling.key[slot_idx:slot_idx + 1])
         tok, sub = self._sample_one(logits, sub)
+        lp = float(chosen_logprob(jnp.asarray(logits), tok)[0])
         self.sampling = SamplingState(
             temperature=self.sampling.temperature,
             top_k=self.sampling.top_k,
             top_p=self.sampling.top_p,
             key=self.sampling.key.at[slot_idx].set(sub.key[0]))
-        return int(tok[0])
+        return int(tok[0]), lp
 
-    def _begin_decode(self, slot_idx: int, first: int, n: int):
+    def _begin_decode(self, slot_idx: int, first: int, n: int,
+                      first_lp: Optional[float] = None):
         """Transition a slot to decoding after its prompt KV is in place
-        (prefill completed or KV imported) and emit the first token."""
+        (prefill completed or KV imported) and emit the first token.
+        ``first_lp`` is None on the PD-import path (the logits never
+        existed on this engine)."""
         slot = self.slots[slot_idx]
         req = slot.request
         slot.prefilling = False
@@ -1208,7 +1218,7 @@ class InferenceEngine:
         self.last_tokens[slot_idx] = first
         if req.first_token_time is None:
             req.first_token_time = time.monotonic()
-        self._emit(slot_idx, first)
+        self._emit(slot_idx, first, logprob=first_lp)
 
     # ------------------------------------------------------------------
     # Page growth + preemption
@@ -1376,7 +1386,7 @@ class InferenceEngine:
                 self._preempt_slot(victim)
 
     def _decode_once(self):
-        cache, sampling, next_tokens = self._decode_fn(
+        cache, sampling, next_tokens, lps = self._decode_fn(
             self.params, self.cache, self.sampling,
             jnp.asarray(self.last_tokens),
             jnp.asarray(self.positions),
@@ -1387,12 +1397,13 @@ class InferenceEngine:
         self.sampling = sampling
         self.counters["decode_steps_total"] += 1
         toks = np.asarray(next_tokens)
+        lps = np.asarray(lps)
         for i, slot in enumerate(self.slots):
             if not self.active[i]:
                 continue
             self.positions[i] += 1
             slot.position += 1
-            self._emit(i, int(toks[i]))
+            self._emit(i, int(toks[i]), logprob=float(lps[i]))
             self.last_tokens[i] = int(toks[i])
 
     def _decode_lookahead(self) -> int:
@@ -1475,7 +1486,7 @@ class InferenceEngine:
             ids = sorted(self._stop_set(slot.request))
             stop[i, :len(ids)] = ids
             left[i] = slot.remaining
-        cache, sampling, toks, acts = fn(
+        cache, sampling, toks, acts, lps = fn(
             self.params, self.cache, self.sampling,
             jnp.asarray(self.last_tokens),
             jnp.asarray(self.positions),
@@ -1489,6 +1500,7 @@ class InferenceEngine:
         self.counters["decode_steps_total"] += K
         toks = np.asarray(toks)       # [K, S]
         acts = np.asarray(acts)       # [K, S] — device active BEFORE step k
+        lps = np.asarray(lps)         # [K, S]
         for k in range(K):
             for i, slot in enumerate(self.slots):
                 # slot.request goes None when _emit retires it mid-trace
@@ -1496,7 +1508,7 @@ class InferenceEngine:
                     continue
                 self.positions[i] += 1
                 slot.position += 1
-                self._emit(i, int(toks[k, i]))
+                self._emit(i, int(toks[k, i]), logprob=float(lps[k, i]))
                 self.last_tokens[i] = int(toks[k, i])
 
     def _stop_set(self, req: Request) -> set:
@@ -1506,12 +1518,15 @@ class InferenceEngine:
             stop_ids.add(eos)
         return stop_ids
 
-    def _emit(self, slot_idx: int, token: int):
+    def _emit(self, slot_idx: int, token: int,
+              logprob: Optional[float] = None):
         """Deliver one generated token; retire the slot when finished."""
         slot = self.slots[slot_idx]
         req = slot.request
         assert req is not None
         req.output_tokens.append(token)
+        if req.params.logprobs:
+            req.output_logprobs.append(logprob)
         slot.remaining -= 1
         self.counters["generation_tokens_total"] += 1
 

@@ -75,6 +75,7 @@ def _wire_request(req: Request) -> dict:
         "stop": list(p.stop_token_ids),
         "seed": p.seed,
         "ignore_eos": p.ignore_eos,
+        "logprobs": p.logprobs,
         "adapter": req.adapter,
     }
 
@@ -84,7 +85,8 @@ def _unwire_request(item: dict) -> Request:
         max_tokens=item["max_tokens"], temperature=item["temperature"],
         top_k=item["top_k"], top_p=item["top_p"],
         stop_token_ids=tuple(item["stop"]), seed=item["seed"],
-        ignore_eos=item["ignore_eos"])
+        ignore_eos=item["ignore_eos"],
+        logprobs=bool(item.get("logprobs", False)))
     return Request(item["req_id"], list(item["tokens"]), params,
                    adapter=item.get("adapter", ""))
 

@@ -58,6 +58,16 @@ class SamplingState:
         )
 
 
+def chosen_logprob(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """log p(token) per row under the UNMODIFIED model distribution
+    (OpenAI logprobs semantics — the sampling mask/temperature do not
+    change the reported values).  logits [B, V] fp32, tokens [B]."""
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    chosen = jnp.take_along_axis(
+        logits, tokens[:, None].astype(jnp.int32), axis=-1)[:, 0]
+    return chosen - lse
+
+
 def sample(logits: jax.Array, state: SamplingState) -> tuple[jax.Array, SamplingState]:
     """Sample one token per row. logits: [B, V] fp32.
 

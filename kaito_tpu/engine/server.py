@@ -270,12 +270,37 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                     return self._error(400, "'prompt' must be a non-empty string")
                 prompt_text = prompt
 
+            # logprobs: per-generated-token log p of the chosen token
+            # under the model distribution; top-k ALTERNATIVES are not
+            # implemented, so requests for them fail loudly
+            if chat:
+                want_lp = bool(body.get("logprobs"))
+                if int(body.get("top_logprobs", 0) or 0) > 0:
+                    return self._error(400, "top_logprobs alternatives are "
+                                            "not supported")
+            else:
+                lp_param = body.get("logprobs")
+                want_lp = lp_param not in (None, False, 0)
+                if want_lp and int(lp_param) > 1:
+                    return self._error(400, "logprobs > 1 (top-k "
+                                            "alternatives) is not supported")
+            stream = bool(body.get("stream", False))
+            if want_lp and stream:
+                return self._error(400, "logprobs are not supported with "
+                                        "streaming")
+            n_choices = int(body.get("n", 1) or 1)
+            if not 1 <= n_choices <= 16:
+                return self._error(400, "'n' must be between 1 and 16")
+            if n_choices > 1 and stream:
+                return self._error(400, "'n' > 1 is not supported with "
+                                        "streaming")
             params = SamplingParams(
                 max_tokens=int(body.get("max_tokens") or 128),
                 temperature=float(body.get("temperature", 1.0)),
                 top_k=int(body.get("top_k", 0) or 0),
                 top_p=float(body.get("top_p", 1.0)),
                 seed=int(body.get("seed", 0) or 0),
+                logprobs=want_lp,
             )
         except (TypeError, ValueError) as e:
             return self._error(400, f"bad parameter: {e}")
@@ -301,6 +326,16 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         if kv_src and adapter:
             return self._error(400, "per-request adapters are not supported "
                                     "with KV transfer")
+        if kv_src and n_choices > 1:
+            return self._error(400, "'n' > 1 is not supported with "
+                                    "KV transfer")
+        if n_choices > 1 and not params.seed:
+            # pin the primary's seed NOW so choice seeds never collide
+            # with the engine's auto-seed counter
+            import dataclasses as _dc
+
+            params = _dc.replace(
+                params, seed=int(uuid.uuid4().hex[:8], 16) | 1)
         try:
             if kv_src:
                 req = self._submit_with_transfer(kv_src, params)
@@ -314,7 +349,22 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         except ValueError as e:
             return self._error(400, str(e))
 
-        stream = bool(body.get("stream", False))
+        # extra choices decode CONCURRENTLY with the first (one engine
+        # request per choice, seeds offset from the pinned primary seed
+        # so sampled paths diverge)
+        extra_reqs = []
+        for ci in range(1, n_choices):
+            import dataclasses as _dc
+
+            p_i = _dc.replace(params, seed=params.seed + ci)
+            try:
+                extra_reqs.append(st.engine.submit(
+                    tokens, p_i, req_id=f"{req.req_id}-{ci}",
+                    adapter=adapter))
+            except ValueError as e:
+                for r in [req] + extra_reqs:
+                    st.engine.abort(r)
+                return self._error(400, str(e))
         created = int(time.time())
         obj = "chat.completion" if chat else "text_completion"
         base = {"id": req.req_id, "object": obj + (".chunk" if stream else ""),
@@ -372,38 +422,82 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             st.metrics.observe_request(req)
             return
 
-        out_ids = list(req.stream())
-        text = st.engine.tokenizer.decode(out_ids)
-        finish = req.finish_reason or "stop"
-        for s in stop_strs:
-            if s in text:
-                text = text[: text.find(s)]
-                finish = "stop"
-        usage = {"prompt_tokens": len(tokens),
-                 "completion_tokens": len(out_ids),
-                 "total_tokens": len(tokens) + len(out_ids)}
-        if chat:
-            # tool-call + reasoning post-processing, gated per-preset
-            # exactly like the reference's parser flags (generator.go)
-            from kaito_tpu.engine.parsers import parse_message
+        choices = []
+        total_completion = 0
+        for idx, r in enumerate([req] + extra_reqs):
+            out_ids = list(r.stream())
+            total_completion += len(out_ids)
+            text = st.engine.tokenizer.decode(out_ids)
+            finish = r.finish_reason or "stop"
+            stop_cut = False
+            for s in stop_strs:
+                if s in text:
+                    text = text[: text.find(s)]
+                    finish = "stop"
+                    stop_cut = True
+            lp_block = None
+            if params.logprobs:
+                # incremental-decode diffs give each token's true
+                # surface form (per-id decode strips SentencePiece
+                # space markers and garbles multi-byte codepoints)
+                tok_strs, prev = [], ""
+                for i in range(len(out_ids)):
+                    cur = st.engine.tokenizer.decode(out_ids[:i + 1])
+                    tok_strs.append(cur[len(prev):])
+                    prev = cur
+                lps = list(r.output_logprobs[:len(out_ids)])
+                if stop_cut:
+                    # align the entries with the RETURNED (trimmed)
+                    # text, not the raw generation
+                    kept, acc = len(out_ids), 0
+                    for i, s_ in enumerate(tok_strs):
+                        if acc >= len(text):
+                            kept = i
+                            break
+                        acc += len(s_)
+                    tok_strs, lps = tok_strs[:kept], lps[:kept]
+                if chat:
+                    lp_block = {"content": [
+                        {"token": s_, "logprob": l_,
+                         "bytes": list(s_.encode())}
+                        for s_, l_ in zip(tok_strs, lps)]}
+                else:
+                    offsets, pos = [], len(prompt_text)
+                    for s_ in tok_strs:
+                        offsets.append(pos)
+                        pos += len(s_)
+                    lp_block = {"tokens": tok_strs, "token_logprobs": lps,
+                                "top_logprobs": None,
+                                "text_offset": offsets}
+            if chat:
+                # tool-call + reasoning post-processing, gated
+                # per-preset exactly like the reference's parser flags
+                # (generator.go)
+                from kaito_tpu.engine.parsers import parse_message
 
-            parsed = parse_message(
-                text,
-                reasoning=bool(getattr(st.engine.md, "reasoning_parser",
-                                       None)),
-                tools=bool(body.get("tools")))
-            message = {"role": "assistant", "content": parsed.content}
-            if parsed.reasoning_content is not None:
-                message["reasoning_content"] = parsed.reasoning_content
-            if parsed.tool_calls:
-                message["tool_calls"] = parsed.tool_calls
-            choice = {"index": 0, "message": message,
-                      "finish_reason": parsed.finish_reason or finish}
-        else:
-            choice = {"index": 0, "text": text, "logprobs": None,
-                      "finish_reason": finish}
+                parsed = parse_message(
+                    text,
+                    reasoning=bool(getattr(st.engine.md,
+                                           "reasoning_parser", None)),
+                    tools=bool(body.get("tools")))
+                message = {"role": "assistant", "content": parsed.content}
+                if parsed.reasoning_content is not None:
+                    message["reasoning_content"] = parsed.reasoning_content
+                if parsed.tool_calls:
+                    message["tool_calls"] = parsed.tool_calls
+                choice = {"index": idx, "message": message,
+                          "finish_reason": parsed.finish_reason or finish}
+                if params.logprobs:
+                    choice["logprobs"] = lp_block
+            else:
+                choice = {"index": idx, "text": text, "logprobs": lp_block,
+                          "finish_reason": finish}
+            choices.append(choice)
+        usage = {"prompt_tokens": len(tokens),
+                 "completion_tokens": total_completion,
+                 "total_tokens": len(tokens) + total_completion}
         resp = dict(base)
-        resp.update({"choices": [choice], "usage": usage})
+        resp.update({"choices": choices, "usage": usage})
         st.metrics.observe_request(req)
         self._json(200, resp)
 

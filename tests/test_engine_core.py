@@ -102,3 +102,47 @@ def test_metrics_counters(engine):
             break
         time.sleep(0.05)
     assert engine.allocator.available == engine.allocator.num_pages - 1
+
+
+def test_chosen_logprob_math():
+    """chosen_logprob = logits[tok] - logsumexp(logits), per row."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kaito_tpu.engine.sampler import chosen_logprob
+
+    rng = np.random.RandomState(0)
+    logits = jnp.asarray(rng.randn(3, 17).astype(np.float32))
+    toks = jnp.asarray([4, 0, 16])
+    got = np.asarray(chosen_logprob(logits, toks))
+    ref = np.asarray(logits) - np.log(
+        np.exp(np.asarray(logits)).sum(-1, keepdims=True))
+    np.testing.assert_allclose(got, ref[np.arange(3), np.asarray(toks)],
+                               rtol=1e-5)
+    assert (got <= 0).all()
+
+
+def test_engine_logprobs_greedy_consistent_across_paths():
+    """Fused and single-step decode report identical logprobs for the
+    same greedy stream (the value is path-independent: model dist)."""
+    from kaito_tpu.engine.config import EngineConfig
+    from kaito_tpu.engine.engine import InferenceEngine, SamplingParams
+
+    def run(run_ahead):
+        eng = InferenceEngine(EngineConfig(
+            model="tiny-llama-test", max_model_len=128, page_size=16,
+            max_num_seqs=2, dtype="float32", kv_dtype="float32",
+            prefill_buckets=(32,), decode_run_ahead=run_ahead,
+            enable_prefix_caching=False))
+        req = eng.submit([5, 6, 7], SamplingParams(
+            max_tokens=8, temperature=0.0, ignore_eos=True, logprobs=True))
+        for _ in range(200):
+            eng.step()
+            if req.finish_reason:
+                break
+        return req.output_tokens, req.output_logprobs
+
+    t1, l1 = run(1)
+    t4, l4 = run(4)
+    assert t1 == t4 and len(l1) == 8
+    assert all(a is not None and abs(a - b) < 1e-4 for a, b in zip(l1, l4))

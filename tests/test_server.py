@@ -198,3 +198,55 @@ def test_loading_stub_answers_probes_then_hands_over():
     finally:
         server.shutdown()
         engine.stop()
+
+
+def test_n_choices(served):
+    url, _ = served
+    out = _post(url, "/v1/completions",
+                {"prompt": "count with me", "max_tokens": 5, "n": 3,
+                 "temperature": 0.8, "seed": 7})
+    assert [c["index"] for c in out["choices"]] == [0, 1, 2]
+    assert out["usage"]["completion_tokens"] == 15
+    try:
+        _post(url, "/v1/completions",
+              {"prompt": "x", "max_tokens": 2, "n": 2, "stream": True})
+        assert False, "expected 400"
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+
+
+def test_completions_logprobs(served):
+    url, _ = served
+    out = _post(url, "/v1/completions",
+                {"prompt": "hello logprobs", "max_tokens": 6,
+                 "temperature": 0, "logprobs": 1})
+    lp = out["choices"][0]["logprobs"]
+    assert len(lp["token_logprobs"]) == 6
+    assert len(lp["tokens"]) == 6 and len(lp["text_offset"]) == 6
+    assert all(isinstance(v, float) and v <= 0.0
+               for v in lp["token_logprobs"])
+    # alternatives are not implemented and must fail loudly
+    try:
+        _post(url, "/v1/completions",
+              {"prompt": "x", "max_tokens": 2, "logprobs": 5})
+        assert False, "expected 400"
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+
+
+def test_chat_logprobs(served):
+    url, _ = served
+    out = _post(url, "/v1/chat/completions",
+                {"messages": [{"role": "user", "content": "hi"}],
+                 "max_tokens": 4, "temperature": 0, "logprobs": True})
+    content = out["choices"][0]["logprobs"]["content"]
+    assert len(content) == 4
+    assert all(e["logprob"] <= 0.0 and isinstance(e["bytes"], list)
+               for e in content)
+    try:
+        _post(url, "/v1/chat/completions",
+              {"messages": [{"role": "user", "content": "x"}],
+               "max_tokens": 2, "logprobs": True, "top_logprobs": 3})
+        assert False, "expected 400"
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
