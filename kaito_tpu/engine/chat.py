@@ -64,6 +64,24 @@ def _mistral(messages) -> str:
     return "".join(out)
 
 
+def _deepseek(messages) -> str:
+    """DeepSeek V3/R1 (and the R1 distills, whose tokenizer configs
+    carry the same template): ``<｜User｜>``/``<｜Assistant｜>`` turns
+    after an optional leading system block (reference templates
+    tool-chat-deepseek{r1,v3}.jinja)."""
+    out = ["<｜begin▁of▁sentence｜>"]
+    for m in messages:
+        role, content = m.get("role"), m.get("content", "")
+        if role == "system":
+            out.append(content)
+        elif role == "user":
+            out.append(f"<｜User｜>{content}")
+        else:
+            out.append(f"<｜Assistant｜>{content}<｜end▁of▁sentence｜>")
+    out.append("<｜Assistant｜>")
+    return "".join(out)
+
+
 def _generic(messages) -> str:
     parts = []
     for m in messages:
@@ -73,8 +91,11 @@ def _generic(messages) -> str:
 
 
 _FAMILY_TEMPLATES = (
-    (("llama-3", "llama3", "deepseek-r1-distill-llama"), _llama3),
-    (("qwen", "chatml", "gpt-oss", "deepseek"), _chatml),
+    # deepseek FIRST: the R1 distills carry llama/qwen in their names
+    # but ship DeepSeek's own chat template
+    (("deepseek",), _deepseek),
+    (("llama-3", "llama3"), _llama3),
+    (("qwen", "chatml", "gpt-oss"), _chatml),
     (("gemma",), _gemma),
     (("phi-", "phi3", "phi4"), _phi),
     (("mistral", "ministral", "mixtral"), _mistral),

@@ -170,3 +170,36 @@ def test_parser_derivation_matches_reference_maps():
         md = get_model_by_name(name)
         assert md.tool_call_parser == tool, name
         assert md.reasoning_parser == reasoning, name
+
+
+def test_chat_template_families():
+    """Family templates match each model family's published format;
+    the R1 distills use DeepSeek's template despite llama/qwen names
+    (reference chat_templates/*.jinja)."""
+    from kaito_tpu.engine.chat import (
+        _chatml,
+        _deepseek,
+        _gemma,
+        _llama3,
+        _mistral,
+        _phi,
+        template_for,
+    )
+
+    assert template_for("deepseek-r1-distill-llama-8b") is _deepseek
+    assert template_for("deepseek-r1-distill-qwen-14b") is _deepseek
+    assert template_for("deepseek-v3-0324") is _deepseek
+    assert template_for("llama-3.1-8b-instruct") is _llama3
+    assert template_for("qwen3-8b") is _chatml
+    assert template_for("gpt-oss-20b") is _chatml
+    assert template_for("gemma-3-4b-instruct") is _gemma
+    assert template_for("phi-4-mini-instruct") is _phi
+    assert template_for("mistral-7b-instruct") is _mistral
+
+    msgs = [{"role": "system", "content": "be brief"},
+            {"role": "user", "content": "hi"}]
+    ds = _deepseek(msgs)
+    assert ds.startswith("<｜begin▁of▁sentence｜>")
+    assert "<｜User｜>hi" in ds and ds.endswith("<｜Assistant｜>")
+    assert _llama3(msgs).endswith(
+        "<|start_header_id|>assistant<|end_header_id|>\n\n")
