@@ -330,6 +330,7 @@ class InferenceEngine:
         self.last_tokens = np.zeros((S,), np.int32)
         self.slot_adapters = np.zeros((S,), np.int32)  # 0 = base model
 
+        self._score_lock = threading.Lock()
         self.waiting: "collections.deque[Request]" = collections.deque()
         self._waiting_count = 0
         self._lock = threading.Lock()
@@ -738,6 +739,75 @@ class InferenceEngine:
             fn = prefill_ctx
             self._prefill_fns[key] = fn
         return fn
+
+    def _score_fn(self, bucket: int):
+        """Jitted prompt scorer: [1, bucket] tokens -> [bucket-1] log
+        p(token[t+1] | tokens[:t+1]) under the model (the lm-eval
+        loglikelihood contract: completions echo+logprobs+max_tokens=0).
+
+        One causal forward; the vocab projection runs in 128-position
+        chunks so a 200k-vocab [T, V] logits tensor never materializes.
+        """
+        key = ("score", bucket)
+        fn = self._prefill_fns.get(key)
+        if fn is None:
+            model = self.model
+            CH = 128
+
+            @jax.jit
+            def score(params, tokens, true_len):
+                B, T = tokens.shape
+                positions = jnp.broadcast_to(
+                    jnp.arange(T, dtype=jnp.int32), (B, T))
+                x = model._embed(params, tokens)
+                x, _ = model._run_layers(
+                    params, None, x, "train", positions=positions,
+                    page_tables=None, lengths=None,
+                    true_lens=jnp.broadcast_to(true_len, (B,)),
+                    active=None, remat=False)
+                h = model._norm(x, params, "final_norm")      # [1, T, E]
+                targets = jnp.concatenate(
+                    [tokens[:, 1:], jnp.zeros((B, 1), jnp.int32)], axis=1)
+                nc = T // CH
+                h_c = h.reshape(nc, CH, h.shape[-1])
+                t_c = targets.reshape(nc, CH)
+
+                def one(args):
+                    hc, tc = args
+                    logits = model._logits(params, hc).astype(jnp.float32)
+                    return chosen_logprob(logits, tc)
+
+                lp = jax.lax.map(one, (h_c, t_c))             # [nc, CH]
+                return lp.reshape(T)[: T - 1]
+
+            fn = score
+            self._prefill_fns[key] = fn
+        return fn
+
+    def score_prompt(self, tokens: list[int]) -> list[float]:
+        """log p of each prompt token given its prefix (None for the
+        first token, which has no conditioning prefix) — runs outside
+        the scheduler; device execution serializes with the loop."""
+        if self.pp_exec is not None:
+            raise ValueError("prompt scoring is not supported on "
+                             "pipeline-parallel engines")
+        n = len(tokens)
+        if n < 1:
+            return []
+        if n >= self.cfg.max_model_len:
+            raise ValueError(f"prompt length {n} exceeds max_model_len "
+                             f"{self.cfg.max_model_len}")
+        # sized directly (NOT via the prefill buckets, whose ceiling is
+        # the chunk budget): any prompt under max_model_len scores
+        bucket = max(128, -(-n // 128) * 128)
+        buf = np.zeros((1, bucket), np.int32)
+        buf[0, :n] = tokens
+        # one scorer at a time: serializes the jit-compile of a new
+        # bucket and keeps burst device pressure bounded
+        with self._score_lock:
+            lp = np.asarray(self._score_fn(bucket)(
+                self.params, jnp.asarray(buf), jnp.asarray(n, jnp.int32)))
+        return [None] + [float(x) for x in lp[: n - 1]]
 
     def _bucket(self, n: int) -> int:
         for b in self.buckets:

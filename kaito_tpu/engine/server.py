@@ -33,6 +33,24 @@ logger = logging.getLogger(__name__)
 from kaito_tpu.engine.adapters import discover_adapters  # noqa: E402
 
 
+
+
+def token_surface_forms(tokenizer, ids, window: int = 8) -> list:
+    """Per-token surface strings via bounded-window incremental decode:
+    full-prefix decode per token is O(n^2) on the handler thread, and
+    per-id decode strips SentencePiece space markers / garbles
+    multi-byte codepoints.  A few tokens of left context make byte
+    merges decode correctly."""
+    out = []
+    ids = list(ids)
+    for i in range(len(ids)):
+        lo = max(0, i - window)
+        prev = tokenizer.decode(ids[lo:i]) if i > lo else ""
+        cur = tokenizer.decode(ids[lo:i + 1])
+        out.append(cur[len(prev):])
+    return out
+
+
 class ServerState:
     def __init__(self, engine: InferenceEngine, cfg: EngineConfig):
         self.engine = engine
@@ -132,6 +150,35 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             self._error(404, f"no route {self.path}")
 
     # ---------------- P/D disaggregation side-channel ----------------
+
+    def _score_prompt(self, body: dict, tokens: list, prompt_text: str,
+                      want_lp: bool):
+        """completions echo+max_tokens=0: return the prompt with its
+        per-token logprobs (lm-eval loglikelihood scoring)."""
+        st = self.state
+        if not want_lp:
+            return self._error(400, "'echo' with max_tokens=0 requires "
+                                    "logprobs")
+        try:
+            lps = st.engine.score_prompt(tokens)
+        except ValueError as e:
+            return self._error(400, str(e))
+        tok_strs = token_surface_forms(st.engine.tokenizer, tokens)
+        offsets, pos = [], 0
+        for s_ in tok_strs:
+            offsets.append(pos)
+            pos += len(s_)
+        choice = {"index": 0, "text": prompt_text, "finish_reason": "stop",
+                  "logprobs": {"tokens": tok_strs, "token_logprobs": lps,
+                               "top_logprobs": None,
+                               "text_offset": offsets}}
+        self._json(200, {
+            "id": f"cmpl-{uuid.uuid4().hex[:20]}",
+            "object": "text_completion", "created": int(time.time()),
+            "model": body.get("model") or st.model_name,
+            "choices": [choice],
+            "usage": {"prompt_tokens": len(tokens), "completion_tokens": 0,
+                      "total_tokens": len(tokens)}})
 
     def _pd_enabled(self) -> bool:
         return bool(self.state.cfg.pd_enabled)
@@ -288,6 +335,13 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             if want_lp and stream:
                 return self._error(400, "logprobs are not supported with "
                                         "streaming")
+            # echo + logprobs + max_tokens=0: prompt SCORING (the
+            # lm-eval loglikelihood contract); echo with generation is
+            # out of scope
+            echo = bool(body.get("echo", False)) and not chat
+            if echo and int(body.get("max_tokens") or 0) > 0:
+                return self._error(400, "'echo' is only supported with "
+                                        "max_tokens=0 (prompt scoring)")
             n_choices = int(body.get("n", 1) or 1)
             if not 1 <= n_choices <= 16:
                 return self._error(400, "'n' must be between 1 and 16")
@@ -329,6 +383,17 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         if kv_src and n_choices > 1:
             return self._error(400, "'n' > 1 is not supported with "
                                     "KV transfer")
+        if echo:
+            # AFTER model-field routing: unknown models 404 above, and
+            # per-request adapters can't be scored (the scorer runs the
+            # base forward)
+            if adapter:
+                return self._error(400, "prompt scoring with a per-request "
+                                        "adapter is not supported")
+            if kv_src:
+                return self._error(400, "prompt scoring with KV transfer "
+                                        "is not supported")
+            return self._score_prompt(body, tokens, prompt_text, want_lp)
         if n_choices > 1 and not params.seed:
             # pin the primary's seed NOW so choice seeds never collide
             # with the engine's auto-seed counter
@@ -437,14 +502,8 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                     stop_cut = True
             lp_block = None
             if params.logprobs:
-                # incremental-decode diffs give each token's true
-                # surface form (per-id decode strips SentencePiece
-                # space markers and garbles multi-byte codepoints)
-                tok_strs, prev = [], ""
-                for i in range(len(out_ids)):
-                    cur = st.engine.tokenizer.decode(out_ids[:i + 1])
-                    tok_strs.append(cur[len(prev):])
-                    prev = cur
+                tok_strs = token_surface_forms(st.engine.tokenizer,
+                                               out_ids)
                 lps = list(r.output_logprobs[:len(out_ids)])
                 if stop_cut:
                     # align the entries with the RETURNED (trimmed)

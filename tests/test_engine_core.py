@@ -146,3 +146,28 @@ def test_engine_logprobs_greedy_consistent_across_paths():
     t4, l4 = run(4)
     assert t1 == t4 and len(l1) == 8
     assert all(a is not None and abs(a - b) < 1e-4 for a, b in zip(l1, l4))
+
+
+def test_score_prompt_matches_forward():
+    """score_prompt == log_softmax(forward_train)[targets] (the
+    loglikelihood contract), computed independently here."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kaito_tpu.engine.config import EngineConfig
+    from kaito_tpu.engine.engine import InferenceEngine
+
+    eng = InferenceEngine(EngineConfig(
+        model="tiny-llama-test", max_model_len=256, page_size=16,
+        max_num_seqs=2, dtype="float32", kv_dtype="float32",
+        prefill_buckets=(32, 64), enable_prefix_caching=False))
+    toks = [5, 9, 2, 14, 7, 3]
+    got = eng.score_prompt(toks)
+    assert got[0] is None and len(got) == len(toks)
+
+    logits = eng.model.forward_train(
+        eng.params, jnp.asarray([toks], jnp.int32), remat=False)
+    lp = jax.nn.log_softmax(logits[0].astype(jnp.float32), axis=-1)
+    want = [float(lp[i, toks[i + 1]]) for i in range(len(toks) - 1)]
+    np.testing.assert_allclose(got[1:], want, rtol=2e-3, atol=2e-4)

@@ -250,3 +250,24 @@ def test_chat_logprobs(served):
         assert False, "expected 400"
     except urllib.error.HTTPError as e:
         assert e.code == 400
+
+
+def test_echo_prompt_scoring(served):
+    url, _ = served
+    out = _post(url, "/v1/completions",
+                {"prompt": "score this prompt", "max_tokens": 0,
+                 "echo": True, "logprobs": 1})
+    ch = out["choices"][0]
+    assert ch["text"] == "score this prompt"
+    lp = ch["logprobs"]
+    assert lp["token_logprobs"][0] is None
+    assert len(lp["token_logprobs"]) == out["usage"]["prompt_tokens"]
+    assert all(v is None or v <= 0.0 for v in lp["token_logprobs"])
+    assert "".join(lp["tokens"]) == ch["text"]
+    assert out["usage"]["completion_tokens"] == 0
+    try:
+        _post(url, "/v1/completions",
+              {"prompt": "x", "max_tokens": 4, "echo": True, "logprobs": 1})
+        assert False, "expected 400"
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
