@@ -29,6 +29,9 @@ from kaito_tpu.engine.rate_limit import RateLimiter
 
 logger = logging.getLogger(__name__)
 
+# one profiler per process (jax.profiler is process-global)
+_PROFILE_LOCK = threading.Lock()
+
 
 from kaito_tpu.engine.adapters import discover_adapters  # noqa: E402
 
@@ -146,8 +149,44 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             self._completions(chat=True)
         elif self.path == "/pd/prefill":
             self._pd_prefill()
+        elif self.path == "/start_profile":
+            self._profile(start=True)
+        elif self.path == "/stop_profile":
+            self._profile(start=False)
         else:
             self._error(404, f"no route {self.path}")
+
+    def _profile(self, start: bool):
+        """vLLM-parity profiler toggles (/start_profile, /stop_profile;
+        the reference wrapper exposes them when the torch profiler dir
+        is set) — TPU-native shape: a jax.profiler trace (XPlane/
+        perfetto) written under KAITO_PROFILE_DIR."""
+        import jax
+
+        st = self.state
+        prof_dir = os.environ.get("KAITO_PROFILE_DIR", "/tmp/kaito-profile")
+        with _PROFILE_LOCK:
+            active = getattr(st, "_profiling", False)
+            try:
+                if start:
+                    if active:
+                        return self._error(409, "profiler already running")
+                    jax.profiler.start_trace(prof_dir)
+                    st._profiling = True
+                    logger.info("profiler trace started -> %s", prof_dir)
+                    return self._json(200, {"status": "started",
+                                            "dir": prof_dir})
+                if not active:
+                    return self._error(409, "profiler not running")
+                jax.profiler.stop_trace()
+                st._profiling = False
+                logger.info("profiler trace stopped")
+                return self._json(200, {"status": "stopped",
+                                        "dir": prof_dir})
+            except Exception as e:
+                st._profiling = False
+                return self._error(500, f"profiler error: {e}",
+                                   "internal_error")
 
     # ---------------- P/D disaggregation side-channel ----------------
 

@@ -271,3 +271,27 @@ def test_echo_prompt_scoring(served):
         assert False, "expected 400"
     except urllib.error.HTTPError as e:
         assert e.code == 400
+
+
+def test_profiler_endpoints(served, tmp_path, monkeypatch):
+    monkeypatch.setenv("KAITO_PROFILE_DIR", str(tmp_path / "prof"))
+    url, _ = served
+    out = _post(url, "/start_profile", {})
+    assert out["status"] == "started"
+    try:
+        _post(url, "/start_profile", {})
+        assert False, "expected 409"
+    except urllib.error.HTTPError as e:
+        assert e.code == 409
+    _post(url, "/v1/completions",
+          {"prompt": "profile me", "max_tokens": 3, "temperature": 0})
+    out = _post(url, "/stop_profile", {})
+    assert out["status"] == "stopped"
+    import os as _os
+
+    assert _os.path.isdir(out["dir"])       # trace artifacts written
+    try:
+        _post(url, "/stop_profile", {})
+        assert False, "expected 409"
+    except urllib.error.HTTPError as e:
+        assert e.code == 409
