@@ -63,6 +63,15 @@ class SamplingParams:
     seed: int = 0
     ignore_eos: bool = False
     logprobs: bool = False     # per-generated-token log p (model dist)
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+    repetition_penalty: float = 1.0
+
+    @property
+    def has_penalties(self) -> bool:
+        return (self.presence_penalty != 0.0
+                or self.frequency_penalty != 0.0
+                or self.repetition_penalty != 1.0)
 
 
 @dataclass
@@ -327,6 +336,14 @@ class InferenceEngine:
         self.positions = np.zeros((S,), np.int32)
         self.active = np.zeros((S,), bool)
         self.sampling = SamplingState.create(S, cfg.seed)
+        # penalty state is LAZY: [S, V] output-token histogram + [S, V]
+        # prompt-seen mask allocate on the first penalized admission
+        # (the decode programs retrace once on the shape change); a
+        # penalty-free engine passes [1, 1] placeholders, which the
+        # sampler's static shape gate compiles to a no-op — zero HBM
+        # and zero per-step cost until someone actually sends a penalty
+        self.token_counts = None
+        self.prompt_seen = None
         self.last_tokens = np.zeros((S,), np.int32)
         self.slot_adapters = np.zeros((S,), np.int32)  # 0 = base model
 
@@ -641,9 +658,9 @@ class InferenceEngine:
         pp_decode = (self.pp_exec.build_decode_fn()
                      if self.pp_exec is not None else None)
 
-        @partial(jax.jit, donate_argnums=(1, 2))
-        def decode_step(params, cache, sampling, tokens, positions,
-                        page_tables, active, adapter_ids):
+        @partial(jax.jit, donate_argnums=(1, 2, 3))
+        def decode_step(params, cache, sampling, counts, prompt_seen,
+                        tokens, positions, page_tables, active, adapter_ids):
             if pp_decode is not None:
                 cache, logits = pp_decode(params, cache, tokens, positions,
                                           page_tables, active)
@@ -651,8 +668,14 @@ class InferenceEngine:
                 cache, logits = model.decode(params, cache, tokens, positions,
                                              page_tables, active,
                                              adapter_ids=adapter_ids)
-            next_tokens, sampling = sample(logits, sampling)
-            return cache, sampling, next_tokens, \
+            next_tokens, sampling = sample(logits, sampling, counts,
+                                           prompt_seen)
+            B = next_tokens.shape[0]
+            if counts.shape == logits.shape:   # penalty state live
+                counts = counts.at[jnp.arange(B), next_tokens].add(
+                    active.astype(jnp.int32))
+            # logprobs report the MODEL distribution (pre-penalty)
+            return cache, sampling, counts, next_tokens, \
                 chosen_logprob(logits, next_tokens)
 
         return decode_step
@@ -667,30 +690,36 @@ class InferenceEngine:
         single-step loop."""
         model = self.model
 
-        @partial(jax.jit, donate_argnums=(1, 2))
-        def decode_multi(params, cache, sampling, tokens, positions,
-                         page_tables, active, adapter_ids, stop_ids,
-                         steps_left):
+        @partial(jax.jit, donate_argnums=(1, 2, 3))
+        def decode_multi(params, cache, sampling, counts, prompt_seen,
+                         tokens, positions, page_tables, active, adapter_ids,
+                         stop_ids, steps_left):
             def body(carry, _):
-                cache, sampling, toks, pos, act, left = carry
+                cache, sampling, counts, toks, pos, act, left = carry
                 cache, logits = model.decode(params, cache, toks, pos,
                                              page_tables, act,
                                              adapter_ids=adapter_ids)
-                nxt, sampling = sample(logits, sampling)
+                nxt, sampling = sample(logits, sampling, counts,
+                                       prompt_seen)
                 lp = chosen_logprob(logits, nxt)
                 nxt = jnp.where(act, nxt, toks)
+                B = nxt.shape[0]
+                if counts.shape == logits.shape:   # penalty state live
+                    counts = counts.at[jnp.arange(B), nxt].add(
+                        act.astype(jnp.int32))
                 left = left - act.astype(jnp.int32)
                 # stop_ids is -1-padded, token ids are >= 0
                 hit = jnp.any(nxt[:, None] == stop_ids, axis=1)
                 act_next = act & ~hit & (left > 0)
                 pos = pos + act.astype(jnp.int32)
-                return (cache, sampling, nxt, pos, act_next, left), \
+                return (cache, sampling, counts, nxt, pos, act_next, left), \
                     (nxt, act, lp)
 
-            carry = (cache, sampling, tokens, positions, active, steps_left)
-            (cache, sampling, *_), (toks, acts, lps) = jax.lax.scan(
+            carry = (cache, sampling, counts, tokens, positions, active,
+                     steps_left)
+            (cache, sampling, counts, *_), (toks, acts, lps) = jax.lax.scan(
                 body, carry, None, length=K)
-            return cache, sampling, toks, acts, lps
+            return cache, sampling, counts, toks, acts, lps
 
         return decode_multi
 
@@ -969,7 +998,8 @@ class InferenceEngine:
         # rows are already in the reset state — skip the device updates
         # on the (common) greedy-traffic path.
         sp = req.params
-        if sp.temperature > 0.0 or sp.top_k > 0 or sp.top_p < 1.0:
+        if sp.temperature > 0.0 or sp.top_k > 0 or sp.top_p < 1.0 \
+                or sp.has_penalties:
             self.sampling = self.sampling.reset_slot(slot_idx)
         slot.request = None
         slot.pages = []
@@ -1013,6 +1043,24 @@ class InferenceEngine:
             poisoned = self.cache.k.is_deleted()
         except Exception:
             poisoned = True
+        # sampling and the penalty histogram are donated alongside the
+        # cache; a failed step leaves them deleted too.  Everything in
+        # flight is failed on this path, so fresh state is correct.
+        try:
+            sampling_poisoned = self.sampling.key.is_deleted()
+        except Exception:
+            sampling_poisoned = True
+        if sampling_poisoned:
+            self.sampling = SamplingState.create(len(self.slots),
+                                                 self.cfg.seed)
+        if self.token_counts is not None:
+            try:
+                counts_poisoned = self.token_counts.is_deleted()
+            except Exception:
+                counts_poisoned = True
+            if counts_poisoned:
+                self.token_counts = None    # lazily re-allocated
+                self.prompt_seen = None
         if poisoned:
             logger.warning("KV cache was donated into a failed step; rebuilding")
             # device contents are gone: nothing in flight may survive and
@@ -1167,7 +1215,30 @@ class InferenceEngine:
             self.sampling = self.sampling.set_slot(
                 free_slot, temperature=req.params.temperature,
                 top_k=req.params.top_k, top_p=req.params.top_p,
-                seed=req.params.seed or self.counters["requests_total"])
+                seed=req.params.seed or self.counters["requests_total"],
+                presence=req.params.presence_penalty,
+                frequency=req.params.frequency_penalty,
+                repetition=req.params.repetition_penalty)
+            if req.params.has_penalties:
+                self._ensure_penalty_state()
+                V = self.md.arch.vocab_size
+                # rows may hold a prior tenant's state (penalty-free
+                # traffic never clears them); resumed requests rebuild
+                # their own output counts
+                if req.output_tokens:
+                    row = np.bincount(
+                        np.asarray(req.output_tokens), minlength=V
+                    )[:V].astype(np.int32)
+                    self.token_counts = self.token_counts.at[
+                        free_slot].set(jnp.asarray(row))
+                else:
+                    self.token_counts = self.token_counts.at[
+                        free_slot].set(0)
+                # repetition penalty sees the PROMPT too (vLLM parity)
+                pmask = np.zeros((V,), bool)
+                pmask[np.clip(np.asarray(req.prompt_tokens), 0, V - 1)] = True
+                self.prompt_seen = self.prompt_seen.at[free_slot].set(
+                    jnp.asarray(pmask))
             if req.kv_import is not None:
                 self._start_imported(req, free_slot)
                 return True
@@ -1256,18 +1327,27 @@ class InferenceEngine:
         return True
 
     def _sample_first(self, slot_idx: int, logits) -> tuple[int, float]:
+        s = self.sampling
         sub = SamplingState(
-            temperature=self.sampling.temperature[slot_idx:slot_idx + 1],
-            top_k=self.sampling.top_k[slot_idx:slot_idx + 1],
-            top_p=self.sampling.top_p[slot_idx:slot_idx + 1],
-            key=self.sampling.key[slot_idx:slot_idx + 1])
-        tok, sub = self._sample_one(logits, sub)
+            temperature=s.temperature[slot_idx:slot_idx + 1],
+            top_k=s.top_k[slot_idx:slot_idx + 1],
+            top_p=s.top_p[slot_idx:slot_idx + 1],
+            key=s.key[slot_idx:slot_idx + 1],
+            presence=s.presence[slot_idx:slot_idx + 1],
+            frequency=s.frequency[slot_idx:slot_idx + 1],
+            repetition=s.repetition[slot_idx:slot_idx + 1])
+        if self.token_counts is not None:
+            tok, sub = self._sample_one(
+                logits, sub, self.token_counts[slot_idx:slot_idx + 1],
+                self.prompt_seen[slot_idx:slot_idx + 1])
+        else:
+            tok, sub = self._sample_one(logits, sub)
         lp = float(chosen_logprob(jnp.asarray(logits), tok)[0])
         self.sampling = SamplingState(
-            temperature=self.sampling.temperature,
-            top_k=self.sampling.top_k,
-            top_p=self.sampling.top_p,
-            key=self.sampling.key.at[slot_idx].set(sub.key[0]))
+            temperature=s.temperature, top_k=s.top_k, top_p=s.top_p,
+            key=s.key.at[slot_idx].set(sub.key[0]),
+            presence=s.presence, frequency=s.frequency,
+            repetition=s.repetition)
         return int(tok[0]), lp
 
     def _begin_decode(self, slot_idx: int, first: int, n: int,
@@ -1288,6 +1368,9 @@ class InferenceEngine:
         self.last_tokens[slot_idx] = first
         if req.first_token_time is None:
             req.first_token_time = time.monotonic()
+        if req.params.has_penalties and self.token_counts is not None:
+            self.token_counts = self.token_counts.at[
+                slot_idx, first].add(1)
         self._emit(slot_idx, first, logprob=first_lp)
 
     # ------------------------------------------------------------------
@@ -1455,9 +1538,28 @@ class InferenceEngine:
                     break
                 self._preempt_slot(victim)
 
+    def _penalty_args(self):
+        """(counts, prompt_seen) for the decode programs: the live
+        [S, V] state, or [1, 1] placeholders that compile the penalty
+        path away."""
+        if self.token_counts is not None:
+            return self.token_counts, self.prompt_seen
+        return (jnp.zeros((1, 1), jnp.int32), jnp.zeros((1, 1), bool))
+
+    def _ensure_penalty_state(self):
+        """First penalized admission: allocate the [S, V] histogram +
+        prompt mask (the decode programs retrace once)."""
+        if self.token_counts is None:
+            S = len(self.slots)
+            V = self.md.arch.vocab_size
+            logger.info("allocating penalty state (%d x %d)", S, V)
+            self.token_counts = jnp.zeros((S, V), jnp.int32)
+            self.prompt_seen = jnp.zeros((S, V), bool)
+
     def _decode_once(self):
-        cache, sampling, next_tokens, lps = self._decode_fn(
-            self.params, self.cache, self.sampling,
+        counts_in, seen = self._penalty_args()
+        cache, sampling, counts, next_tokens, lps = self._decode_fn(
+            self.params, self.cache, self.sampling, counts_in, seen,
             jnp.asarray(self.last_tokens),
             jnp.asarray(self.positions),
             jnp.asarray(self.page_tables),
@@ -1465,6 +1567,8 @@ class InferenceEngine:
             jnp.asarray(self.slot_adapters))
         self.cache = cache
         self.sampling = sampling
+        if self.token_counts is not None:
+            self.token_counts = counts
         self.counters["decode_steps_total"] += 1
         toks = np.asarray(next_tokens)
         lps = np.asarray(lps)
@@ -1556,8 +1660,9 @@ class InferenceEngine:
             ids = sorted(self._stop_set(slot.request))
             stop[i, :len(ids)] = ids
             left[i] = slot.remaining
-        cache, sampling, toks, acts, lps = fn(
-            self.params, self.cache, self.sampling,
+        counts_in, seen = self._penalty_args()
+        cache, sampling, counts, toks, acts, lps = fn(
+            self.params, self.cache, self.sampling, counts_in, seen,
             jnp.asarray(self.last_tokens),
             jnp.asarray(self.positions),
             jnp.asarray(self.page_tables),
@@ -1567,6 +1672,8 @@ class InferenceEngine:
             jnp.asarray(left))
         self.cache = cache
         self.sampling = sampling
+        if self.token_counts is not None:
+            self.token_counts = counts
         self.counters["decode_steps_total"] += K
         toks = np.asarray(toks)       # [K, S]
         acts = np.asarray(acts)       # [K, S] — device active BEFORE step k

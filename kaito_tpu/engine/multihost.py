@@ -76,6 +76,9 @@ def _wire_request(req: Request) -> dict:
         "seed": p.seed,
         "ignore_eos": p.ignore_eos,
         "logprobs": p.logprobs,
+        "presence_penalty": p.presence_penalty,
+        "frequency_penalty": p.frequency_penalty,
+        "repetition_penalty": p.repetition_penalty,
         "adapter": req.adapter,
     }
 
@@ -86,7 +89,10 @@ def _unwire_request(item: dict) -> Request:
         top_k=item["top_k"], top_p=item["top_p"],
         stop_token_ids=tuple(item["stop"]), seed=item["seed"],
         ignore_eos=item["ignore_eos"],
-        logprobs=bool(item.get("logprobs", False)))
+        logprobs=bool(item.get("logprobs", False)),
+        presence_penalty=float(item.get("presence_penalty", 0.0)),
+        frequency_penalty=float(item.get("frequency_penalty", 0.0)),
+        repetition_penalty=float(item.get("repetition_penalty", 1.0)))
     return Request(item["req_id"], list(item["tokens"]), params,
                    adapter=item.get("adapter", ""))
 

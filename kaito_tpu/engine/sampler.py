@@ -23,39 +23,57 @@ class SamplingState:
     top_k: jax.Array         # 0 => disabled
     top_p: jax.Array         # 1.0 => disabled
     key: jax.Array           # [B, 2] per-slot PRNG keys
+    presence: jax.Array      # 0 => disabled (OpenAI presence_penalty)
+    frequency: jax.Array     # 0 => disabled (OpenAI frequency_penalty)
+    repetition: jax.Array    # 1 => disabled (HF/vLLM repetition_penalty)
 
     @staticmethod
     def create(batch: int, seed: int = 0) -> "SamplingState":
         keys = jax.random.split(jax.random.PRNGKey(seed), batch)
-        # idle rows are greedy/no-mask so the sampler's sort-skipping
-        # and draw-skipping gates (which read every row) stay enabled on
-        # a fresh engine; admission overwrites the row via set_slot
+        # idle rows are greedy/no-mask/no-penalty so the sampler's
+        # cond gates (which read every row) stay enabled on a fresh
+        # engine; admission overwrites the row via set_slot
         return SamplingState(
             temperature=jnp.zeros((batch,), jnp.float32),
             top_k=jnp.zeros((batch,), jnp.int32),
             top_p=jnp.ones((batch,), jnp.float32),
             key=jnp.asarray(keys, jnp.uint32),
+            presence=jnp.zeros((batch,), jnp.float32),
+            frequency=jnp.zeros((batch,), jnp.float32),
+            repetition=jnp.ones((batch,), jnp.float32),
         )
 
     def reset_slot(self, i: int) -> "SamplingState":
-        """Greedy/no-mask row without touching the PRNG key (admission
-        reseeds it): keeps retirement to three tiny scatters."""
+        """Greedy/no-mask/no-penalty row without touching the PRNG key
+        (admission reseeds it): retirement stays a few tiny scatters."""
         return SamplingState(
             temperature=self.temperature.at[i].set(0.0),
             top_k=self.top_k.at[i].set(0),
             top_p=self.top_p.at[i].set(1.0),
             key=self.key,
+            presence=self.presence.at[i].set(0.0),
+            frequency=self.frequency.at[i].set(0.0),
+            repetition=self.repetition.at[i].set(1.0),
         )
 
     def set_slot(self, i: int, *, temperature: float, top_k: int, top_p: float,
-                 seed: int) -> "SamplingState":
+                 seed: int, presence: float = 0.0, frequency: float = 0.0,
+                 repetition: float = 1.0) -> "SamplingState":
         key = jax.random.fold_in(jax.random.PRNGKey(seed), i)
         return SamplingState(
             temperature=self.temperature.at[i].set(temperature),
             top_k=self.top_k.at[i].set(top_k),
             top_p=self.top_p.at[i].set(top_p),
             key=self.key.at[i].set(jnp.asarray(key, jnp.uint32)),
+            presence=self.presence.at[i].set(presence),
+            frequency=self.frequency.at[i].set(frequency),
+            repetition=self.repetition.at[i].set(repetition),
         )
+
+    @property
+    def any_penalty(self) -> jax.Array:
+        return jnp.any((self.presence != 0.0) | (self.frequency != 0.0)
+                       | (self.repetition != 1.0))
 
 
 def chosen_logprob(logits: jax.Array, tokens: jax.Array) -> jax.Array:
@@ -68,8 +86,36 @@ def chosen_logprob(logits: jax.Array, tokens: jax.Array) -> jax.Array:
     return chosen - lse
 
 
-def sample(logits: jax.Array, state: SamplingState) -> tuple[jax.Array, SamplingState]:
-    """Sample one token per row. logits: [B, V] fp32.
+def apply_penalties(logits: jax.Array, state: SamplingState,
+                    counts: jax.Array, prompt_seen=None) -> jax.Array:
+    """Sampling penalties, gated behind a cond like the sort path — a
+    [B, V] read-modify-write per step must cost nothing for
+    penalty-free batches.
+
+    vLLM semantics: presence/frequency consider OUTPUT tokens only
+    (``counts``, [B, V] int32 histogram); repetition_penalty considers
+    prompt AND output (``prompt_seen``, [B, V] bool)."""
+
+    def apply(l):
+        c = counts.astype(jnp.float32)
+        out_seen = c > 0
+        rep_seen = out_seen if prompt_seen is None \
+            else (out_seen | prompt_seen)
+        rep = state.repetition[:, None]
+        l = jnp.where(rep_seen & (l > 0), l / rep,
+                      jnp.where(rep_seen, l * rep, l))
+        return l - state.frequency[:, None] * c \
+            - state.presence[:, None] * out_seen.astype(jnp.float32)
+
+    return jax.lax.cond(state.any_penalty, apply, lambda l: l, logits)
+
+
+def sample(logits: jax.Array, state: SamplingState,
+           counts=None, prompt_seen=None) -> tuple[jax.Array, SamplingState]:
+    """Sample one token per row. logits: [B, V] fp32; counts: optional
+    [B, V] output-token histogram for penalties (a shape-mismatched
+    placeholder statically disables the penalty path, so penalty-free
+    engines never allocate or touch [B, V] state).
 
     The sort-based top-k/top-p masking and the categorical draw are
     gated behind ``lax.cond`` on what the batch actually requests: a
@@ -78,6 +124,8 @@ def sample(logits: jax.Array, state: SamplingState) -> tuple[jax.Array, Sampling
     path is bit-identical to the always-sort implementation whenever any
     slot enables top-k/top-p."""
     B, V = logits.shape
+    if counts is not None and counts.shape == logits.shape:
+        logits = apply_penalties(logits, state, counts, prompt_seen)
     temp = jnp.maximum(state.temperature, 1e-6)[:, None]
     scaled = logits / temp
 
@@ -122,5 +170,6 @@ def sample(logits: jax.Array, state: SamplingState) -> tuple[jax.Array, Sampling
     tokens = jnp.where(random_row, sampled, greedy)
     new_state = SamplingState(
         temperature=state.temperature, top_k=state.top_k, top_p=state.top_p,
-        key=new_keys)
+        key=new_keys, presence=state.presence, frequency=state.frequency,
+        repetition=state.repetition)
     return tokens.astype(jnp.int32), new_state

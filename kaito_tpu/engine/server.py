@@ -164,6 +164,11 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         import jax
 
         st = self.state
+        # drain the body (clients POST JSON here); an unread payload
+        # would desync the next request on a keep-alive connection
+        n = int(self.headers.get("Content-Length", "0") or 0)
+        if n:
+            self.rfile.read(n)
         prof_dir = os.environ.get("KAITO_PROFILE_DIR", "/tmp/kaito-profile")
         with _PROFILE_LOCK:
             active = getattr(st, "_profiling", False)
@@ -394,6 +399,12 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                 top_p=float(body.get("top_p", 1.0)),
                 seed=int(body.get("seed", 0) or 0),
                 logprobs=want_lp,
+                presence_penalty=float(body.get("presence_penalty", 0.0)
+                                       or 0.0),
+                frequency_penalty=float(body.get("frequency_penalty", 0.0)
+                                        or 0.0),
+                repetition_penalty=float(body.get("repetition_penalty", 1.0)
+                                         or 1.0),
             )
         except (TypeError, ValueError) as e:
             return self._error(400, f"bad parameter: {e}")

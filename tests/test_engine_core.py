@@ -171,3 +171,56 @@ def test_score_prompt_matches_forward():
     lp = jax.nn.log_softmax(logits[0].astype(jnp.float32), axis=-1)
     want = [float(lp[i, toks[i + 1]]) for i in range(len(toks) - 1)]
     np.testing.assert_allclose(got[1:], want, rtol=2e-3, atol=2e-4)
+
+
+def test_sampling_penalties():
+    """Penalty math (manual reference) + engine behavior: repetition
+    penalty breaks greedy loops; fused and single-step paths agree."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kaito_tpu.engine.config import EngineConfig
+    from kaito_tpu.engine.engine import InferenceEngine, SamplingParams
+    from kaito_tpu.engine.sampler import SamplingState, apply_penalties
+
+    # unit math: presence subtracts once, frequency per count,
+    # repetition divides positive / multiplies negative logits
+    st = SamplingState.create(1)
+    st = st.set_slot(0, temperature=0.0, top_k=0, top_p=1.0, seed=1,
+                     presence=0.5, frequency=0.25, repetition=2.0)
+    logits = jnp.asarray([[2.0, -1.0, 0.5, 3.0]])
+    counts = jnp.asarray([[2, 1, 0, 0]], jnp.int32)
+    got = np.asarray(apply_penalties(logits, st, counts))[0]
+    np.testing.assert_allclose(
+        got, [2.0 / 2 - 0.25 * 2 - 0.5, -1.0 * 2 - 0.25 - 0.5, 0.5, 3.0],
+        rtol=1e-6)
+
+    def run(run_ahead, **pk):
+        eng = InferenceEngine(EngineConfig(
+            model="tiny-llama-test", max_model_len=256, page_size=16,
+            max_num_seqs=2, dtype="float32", kv_dtype="float32",
+            prefill_buckets=(32,), decode_run_ahead=run_ahead,
+            enable_prefix_caching=False))
+        req = eng.submit([5, 6, 7], SamplingParams(
+            max_tokens=24, temperature=0.0, ignore_eos=True, **pk))
+        for _ in range(400):
+            eng.step()
+            if req.finish_reason:
+                break
+        return req.output_tokens
+
+    base = run(1)
+    pen1 = run(1, repetition_penalty=1.3, presence_penalty=0.4)
+    pen4 = run(4, repetition_penalty=1.3, presence_penalty=0.4)
+    assert pen1 == pen4                      # path-independent
+    # the synthetic tiny model loops hard under greedy; penalties must
+    # strictly reduce repetition
+    def max_run(seq):
+        best = cur = 1
+        for a, b in zip(seq, seq[1:]):
+            cur = cur + 1 if a == b else 1
+            best = max(best, cur)
+        return best
+    assert len(set(pen1)) >= len(set(base))
+    assert max_run(pen1) <= max_run(base)
+    assert pen1 != base
