@@ -107,8 +107,9 @@ class MultiHostEngine(InferenceEngine):
 
     def __init__(self, cfg, metadata=None, params=None, mesh=None):
         if cfg.pd_enabled:
-            raise ValueError("P/D disaggregation is single-host per role "
-                             "in this round")
+            raise ValueError("P/D disaggregation runs single-host per "
+                             "role (each role scales with InferenceSet "
+                             "replicas, not multi-host lockstep)")
         self.is_leader = jax.process_index() == 0
         super().__init__(cfg, metadata=metadata, params=params, mesh=mesh)
         self._staged: "collections.deque[Request]" = collections.deque()
