@@ -66,6 +66,7 @@ class SamplingParams:
     presence_penalty: float = 0.0
     frequency_penalty: float = 0.0
     repetition_penalty: float = 1.0
+    min_p: float = 0.0
 
     @property
     def has_penalties(self) -> bool:
@@ -999,7 +1000,7 @@ class InferenceEngine:
         # on the (common) greedy-traffic path.
         sp = req.params
         if sp.temperature > 0.0 or sp.top_k > 0 or sp.top_p < 1.0 \
-                or sp.has_penalties:
+                or sp.min_p > 0.0 or sp.has_penalties:
             self.sampling = self.sampling.reset_slot(slot_idx)
         slot.request = None
         slot.pages = []
@@ -1218,7 +1219,8 @@ class InferenceEngine:
                 seed=req.params.seed or self.counters["requests_total"],
                 presence=req.params.presence_penalty,
                 frequency=req.params.frequency_penalty,
-                repetition=req.params.repetition_penalty)
+                repetition=req.params.repetition_penalty,
+                min_p=req.params.min_p)
             if req.params.has_penalties:
                 self._ensure_penalty_state()
                 V = self.md.arch.vocab_size
@@ -1335,7 +1337,8 @@ class InferenceEngine:
             key=s.key[slot_idx:slot_idx + 1],
             presence=s.presence[slot_idx:slot_idx + 1],
             frequency=s.frequency[slot_idx:slot_idx + 1],
-            repetition=s.repetition[slot_idx:slot_idx + 1])
+            repetition=s.repetition[slot_idx:slot_idx + 1],
+            min_p=s.min_p[slot_idx:slot_idx + 1])
         if self.token_counts is not None:
             tok, sub = self._sample_one(
                 logits, sub, self.token_counts[slot_idx:slot_idx + 1],
@@ -1347,7 +1350,7 @@ class InferenceEngine:
             temperature=s.temperature, top_k=s.top_k, top_p=s.top_p,
             key=s.key.at[slot_idx].set(sub.key[0]),
             presence=s.presence, frequency=s.frequency,
-            repetition=s.repetition)
+            repetition=s.repetition, min_p=s.min_p)
         return int(tok[0]), lp
 
     def _begin_decode(self, slot_idx: int, first: int, n: int,

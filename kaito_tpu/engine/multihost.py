@@ -79,6 +79,7 @@ def _wire_request(req: Request) -> dict:
         "presence_penalty": p.presence_penalty,
         "frequency_penalty": p.frequency_penalty,
         "repetition_penalty": p.repetition_penalty,
+        "min_p": p.min_p,
         "adapter": req.adapter,
     }
 
@@ -92,7 +93,8 @@ def _unwire_request(item: dict) -> Request:
         logprobs=bool(item.get("logprobs", False)),
         presence_penalty=float(item.get("presence_penalty", 0.0)),
         frequency_penalty=float(item.get("frequency_penalty", 0.0)),
-        repetition_penalty=float(item.get("repetition_penalty", 1.0)))
+        repetition_penalty=float(item.get("repetition_penalty", 1.0)),
+        min_p=float(item.get("min_p", 0.0)))
     return Request(item["req_id"], list(item["tokens"]), params,
                    adapter=item.get("adapter", ""))
 

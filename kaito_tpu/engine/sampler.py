@@ -26,6 +26,7 @@ class SamplingState:
     presence: jax.Array      # 0 => disabled (OpenAI presence_penalty)
     frequency: jax.Array     # 0 => disabled (OpenAI frequency_penalty)
     repetition: jax.Array    # 1 => disabled (HF/vLLM repetition_penalty)
+    min_p: jax.Array         # 0 => disabled (vLLM min_p)
 
     @staticmethod
     def create(batch: int, seed: int = 0) -> "SamplingState":
@@ -41,6 +42,7 @@ class SamplingState:
             presence=jnp.zeros((batch,), jnp.float32),
             frequency=jnp.zeros((batch,), jnp.float32),
             repetition=jnp.ones((batch,), jnp.float32),
+            min_p=jnp.zeros((batch,), jnp.float32),
         )
 
     def reset_slot(self, i: int) -> "SamplingState":
@@ -54,11 +56,13 @@ class SamplingState:
             presence=self.presence.at[i].set(0.0),
             frequency=self.frequency.at[i].set(0.0),
             repetition=self.repetition.at[i].set(1.0),
+            min_p=self.min_p.at[i].set(0.0),
         )
 
     def set_slot(self, i: int, *, temperature: float, top_k: int, top_p: float,
                  seed: int, presence: float = 0.0, frequency: float = 0.0,
-                 repetition: float = 1.0) -> "SamplingState":
+                 repetition: float = 1.0, min_p: float = 0.0
+                 ) -> "SamplingState":
         key = jax.random.fold_in(jax.random.PRNGKey(seed), i)
         return SamplingState(
             temperature=self.temperature.at[i].set(temperature),
@@ -68,6 +72,7 @@ class SamplingState:
             presence=self.presence.at[i].set(presence),
             frequency=self.frequency.at[i].set(frequency),
             repetition=self.repetition.at[i].set(repetition),
+            min_p=self.min_p.at[i].set(min_p),
         )
 
     @property
@@ -146,10 +151,20 @@ def sample(logits: jax.Array, state: SamplingState,
                                          axis=-1)
         return jnp.where(out < cutoff_val, -jnp.inf, out)
 
+    def mask_min_p(scaled):
+        # vLLM min_p: drop tokens whose prob is below min_p * max_prob
+        # (scale-invariant in logit space: logit < max_logit + log(min_p))
+        mx = jnp.max(scaled, axis=-1, keepdims=True)
+        thresh = mx + jnp.log(jnp.maximum(state.min_p, 1e-10))[:, None]
+        keep_all = (state.min_p <= 0.0)[:, None]
+        return jnp.where(keep_all | (scaled >= thresh), scaled, -jnp.inf)
+
     random_row = state.temperature > 0.0
     need_mask = jnp.any(random_row & ((state.top_k > 0)
                                       | (state.top_p < 1.0)))
     scaled = jax.lax.cond(need_mask, mask_topk_topp, lambda s: s, scaled)
+    need_min_p = jnp.any(random_row & (state.min_p > 0.0))
+    scaled = jax.lax.cond(need_min_p, mask_min_p, lambda s: s, scaled)
 
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
@@ -171,5 +186,5 @@ def sample(logits: jax.Array, state: SamplingState,
     new_state = SamplingState(
         temperature=state.temperature, top_k=state.top_k, top_p=state.top_p,
         key=new_keys, presence=state.presence, frequency=state.frequency,
-        repetition=state.repetition)
+        repetition=state.repetition, min_p=state.min_p)
     return tokens.astype(jnp.int32), new_state

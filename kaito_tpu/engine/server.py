@@ -405,6 +405,7 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                                         or 0.0),
                 repetition_penalty=float(body.get("repetition_penalty", 1.0)
                                          or 1.0),
+                min_p=float(body.get("min_p", 0.0) or 0.0),
             )
         except (TypeError, ValueError) as e:
             return self._error(400, f"bad parameter: {e}")

@@ -224,3 +224,26 @@ def test_sampling_penalties():
     assert len(set(pen1)) >= len(set(base))
     assert max_run(pen1) <= max_run(base)
     assert pen1 != base
+
+
+def test_min_p_masks_tail():
+    """min_p keeps only tokens with prob >= min_p * max_prob (vLLM
+    semantics); a high min_p at temperature 1 forces the argmax."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kaito_tpu.engine.sampler import SamplingState, sample
+
+    st = SamplingState.create(1)
+    st = st.set_slot(0, temperature=1.0, top_k=0, top_p=1.0, seed=3,
+                     min_p=0.99)
+    logits = jnp.asarray([[3.0, 2.0, 1.0, 0.0]])
+    toks = {int(sample(logits, st.set_slot(
+        0, temperature=1.0, top_k=0, top_p=1.0, seed=s, min_p=0.99))[0][0])
+        for s in range(1, 6)}
+    assert toks == {0}      # only the max survives a 0.99 min_p
+    # min_p=0 leaves sampling unconstrained (several tokens appear)
+    toks = {int(sample(logits, st.set_slot(
+        0, temperature=1.0, top_k=0, top_p=1.0, seed=s))[0][0])
+        for s in range(1, 30)}
+    assert len(toks) > 1
