@@ -48,6 +48,17 @@ class EngineConfig:
     # decode_run_ahead so admissions and prefill chunks keep a bounded
     # latency; 0 restores the round-2 collapse-to-single-step behavior
     fused_under_load: int = 4
+    # n-gram (prompt-lookup) speculative decoding: propose up to N
+    # continuation tokens by matching the trailing n-gram against the
+    # sequence's own context, verify them in ONE windowed dispatch, and
+    # emit the accepted prefix + a bonus token — exact greedy
+    # equivalence, no draft model.  0 = off.  Engages only when every
+    # active slot is greedy and the batch is at most
+    # speculative_max_batch (the [B, W, V] verify logits stay small;
+    # speculation pays off in the low-batch latency regime anyway).
+    speculative_ngram: int = 0
+    speculative_min_match: int = 2
+    speculative_max_batch: int = 8
     # serving-side knobs carried over from the reference wrapper surface
     port: int = 5000
     served_model_name: str = ""

@@ -372,6 +372,9 @@ class InferenceEngine:
             "preemptions_total": 0,
             "host_kv_spilled_pages_total": 0,
             "host_kv_restored_pages_total": 0,
+            "spec_steps_total": 0,
+            "spec_proposed_tokens_total": 0,
+            "spec_accepted_tokens_total": 0,
         }
 
         self._decode_fn = self._build_decode_fn()
@@ -669,8 +672,20 @@ class InferenceEngine:
                 cache, logits = model.decode(params, cache, tokens, positions,
                                              page_tables, active,
                                              adapter_ids=adapter_ids)
-            next_tokens, sampling = sample(logits, sampling, counts,
-                                           prompt_seen)
+            next_tokens, new_sampling = sample(logits, sampling, counts,
+                                               prompt_seen)
+            # inactive rows keep their PRNG keys: a sampled stream must
+            # be seed-deterministic regardless of co-tenant scheduling
+            # (prefilling/idle rows never burn draws)
+            sampling = SamplingState(
+                temperature=new_sampling.temperature,
+                top_k=new_sampling.top_k, top_p=new_sampling.top_p,
+                key=jnp.where(active[:, None], new_sampling.key,
+                              sampling.key),
+                presence=new_sampling.presence,
+                frequency=new_sampling.frequency,
+                repetition=new_sampling.repetition,
+                min_p=new_sampling.min_p)
             B = next_tokens.shape[0]
             if counts.shape == logits.shape:   # penalty state live
                 counts = counts.at[jnp.arange(B), next_tokens].add(
@@ -700,8 +715,17 @@ class InferenceEngine:
                 cache, logits = model.decode(params, cache, toks, pos,
                                              page_tables, act,
                                              adapter_ids=adapter_ids)
-                nxt, sampling = sample(logits, sampling, counts,
-                                       prompt_seen)
+                nxt, new_sampling = sample(logits, sampling, counts,
+                                           prompt_seen)
+                sampling = SamplingState(
+                    temperature=new_sampling.temperature,
+                    top_k=new_sampling.top_k, top_p=new_sampling.top_p,
+                    key=jnp.where(act[:, None], new_sampling.key,
+                                  sampling.key),
+                    presence=new_sampling.presence,
+                    frequency=new_sampling.frequency,
+                    repetition=new_sampling.repetition,
+                    min_p=new_sampling.min_p)
                 lp = chosen_logprob(logits, nxt)
                 nxt = jnp.where(act, nxt, toks)
                 B = nxt.shape[0]
@@ -1097,21 +1121,28 @@ class InferenceEngine:
         decoding = bool(self.active.any())
         steps_run = 0
         if decoding:
-            # recompute after admission: ensure-pages may have preempted
-            # (queue non-empty caps K at fused_under_load), and
-            # KV-import / spill-restore admissions begin decoding
-            # immediately — their slots post-date the reservation pass,
-            # so a fused dispatch must re-reserve lookahead pages first
-            la2 = self._decode_lookahead()
-            if la2 > 1:
-                if did or la2 > la:
-                    self._ensure_decode_pages(la2)
-                self._decode_multi(la2)
-                steps_run = la2
+            spec_emitted = (self._decode_speculative()
+                            if self._spec_ok() else 0)
+            if spec_emitted:
+                steps_run = spec_emitted
+                did = True
             else:
-                self._decode_once()
-                steps_run = 1
-            did = True
+                # recompute after admission: ensure-pages may have
+                # preempted (queue non-empty caps K at
+                # fused_under_load), and KV-import / spill-restore
+                # admissions begin decoding immediately — their slots
+                # post-date the reservation pass, so a fused dispatch
+                # must re-reserve lookahead pages first
+                la2 = self._decode_lookahead()
+                if la2 > 1:
+                    if did or la2 > la:
+                        self._ensure_decode_pages(la2)
+                    self._decode_multi(la2)
+                    steps_run = la2
+                else:
+                    self._decode_once()
+                    steps_run = 1
+                did = True
         self._tick += 1
         # prefill cadence counts DECODE STEPS, not scheduler iterations:
         # a fused K-step dispatch advances the clock by K, so the
@@ -1690,6 +1721,144 @@ class InferenceEngine:
                 slot.position += 1
                 self._emit(i, int(toks[k, i]), logprob=float(lps[k, i]))
                 self.last_tokens[i] = int(toks[k, i])
+
+    # ------------------------------------------------------------------
+    # n-gram (prompt-lookup) speculative decoding
+    # ------------------------------------------------------------------
+
+    def _spec_ok(self) -> bool:
+        """Speculate only when it is exact and cheap: engine opted in,
+        no PP executor (the verify path drives the model directly),
+        every active slot greedy (acceptance is deterministic argmax
+        equality), and the batch small enough that the on-device
+        [B, W, V] verify logits stay negligible."""
+        cfg = self.cfg
+        if cfg.speculative_ngram <= 0 or self.pp_exec is not None:
+            return False
+        n_active = 0
+        for i, s in enumerate(self.slots):
+            if s.request is None or not self.active[i]:
+                continue
+            n_active += 1
+            if s.request.params.temperature > 0.0 \
+                    or s.request.params.has_penalties \
+                    or s.request.aborted:
+                return False
+        return 0 < n_active <= cfg.speculative_max_batch
+
+    def _propose(self, req: Request) -> list[int]:
+        """Prompt-lookup proposal: find the last earlier occurrence of
+        the sequence's trailing n-gram and propose the tokens that
+        followed it (vLLM's ngram speculator recipe)."""
+        k = self.cfg.speculative_min_match
+        K = self.cfg.speculative_ngram
+        ctx_list = req.resume_tokens()
+        if len(ctx_list) <= k:
+            return []
+        ctx = np.asarray(ctx_list[-4096:], np.int32)   # bound the scan
+        tail = ctx[-k:]
+        # vectorized: candidate starts where the first tail element
+        # matches, newest first; full k-gram compare only on candidates
+        starts = np.flatnonzero(ctx[: len(ctx) - k] == tail[0])
+        for i in starts[::-1]:
+            if np.array_equal(ctx[i:i + k], tail):
+                out = ctx[i + k: i + k + K]
+                if len(out):
+                    return [int(t) for t in out]
+        return []
+
+    def _verify_fn(self, W: int):
+        key = ("verify", W)
+        fn = self._prefill_fns.get(key)
+        if fn is None:
+            model = self.model
+
+            @partial(jax.jit, donate_argnums=(1,))
+            def verify(params, cache, tokens, true_lens, page_tables,
+                       start_pos, adapter_ids):
+                return model.verify_window(params, cache, tokens,
+                                           true_lens, page_tables,
+                                           start_pos,
+                                           adapter_ids=adapter_ids)
+
+            fn = self._prefill_fns[key] = verify
+        return fn
+
+    def _decode_speculative(self) -> int:
+        """One windowed verify dispatch over a COMPACT batch of the
+        speculating slots (padded to speculative_max_batch so one
+        program serves every step; the [B, W, V] verify logits stay
+        bounded by the gate's B, not max_num_seqs).  Every covered slot
+        advances by its accepted-proposal prefix plus one bonus token.
+        Returns the max tokens any slot emitted (the prefill-cadence
+        clock), or 0 when speculation should not run this step (no
+        proposals anywhere, or the page pool cannot fund the window
+        without preempting) — the caller falls through to the normal
+        decode paths."""
+        W = self.cfg.speculative_ngram + 1
+        rows: list[int] = []          # compact row -> slot index
+        proposals: list[list[int]] = []
+        any_proposal = False
+        for i, slot in enumerate(self.slots):
+            if slot.request is None or not self.active[i]:
+                continue
+            p = self._propose(slot.request)
+            # never speculate past the budget: tokens beyond remaining
+            # would be emitted-and-truncated work
+            p = p[: max(0, slot.remaining - 1)]
+            any_proposal = any_proposal or bool(p)
+            rows.append(i)
+            proposals.append(p)
+        if not rows or not any_proposal:
+            return 0      # nothing to verify: the fused path is cheaper
+        if not self._lookahead_fits(W):
+            # same invariant as the fused path: speculative pages must
+            # never preempt a running sequence
+            return 0
+        self._ensure_decode_pages(W)
+        B = self.cfg.speculative_max_batch
+        toks = np.zeros((B, W), np.int32)
+        tl = np.zeros((B,), np.int32)
+        sp = np.zeros((B,), np.int32)
+        tables = np.zeros((B, self.pages_per_seq), np.int32)
+        aids = np.zeros((B,), np.int32)
+        for r, (i, p) in enumerate(zip(rows, proposals)):
+            window = [int(self.last_tokens[i])] + p
+            toks[r, : len(window)] = window
+            tl[r] = len(window)
+            sp[r] = self.slots[i].position
+            tables[r] = self.page_tables[i]
+            aids[r] = self.slot_adapters[i]
+        cache, targets, lps = self._verify_fn(W)(
+            self.params, self.cache, jnp.asarray(toks), jnp.asarray(tl),
+            jnp.asarray(tables), jnp.asarray(sp), jnp.asarray(aids))
+        self.cache = cache
+        targets = np.asarray(targets)
+        lps = np.asarray(lps)
+        self.counters["decode_steps_total"] += 1
+        self.counters["spec_steps_total"] += 1
+        max_emitted = 0
+        for r, (i, p) in enumerate(zip(rows, proposals)):
+            slot = self.slots[i]
+            if slot.request is None:
+                continue
+            a = 0
+            while a < len(p) and p[a] == int(targets[r, a]):
+                a += 1
+            emitted = p[:a] + [int(targets[r, a])]
+            self.counters["spec_proposed_tokens_total"] += len(p)
+            self.counters["spec_accepted_tokens_total"] += a
+            want_lp = slot.request.params.logprobs
+            for j, t in enumerate(emitted):
+                if slot.request is None:
+                    break        # retired mid-window (stop/budget/abort)
+                self.positions[i] += 1
+                slot.position += 1
+                self._emit(i, t,
+                           logprob=float(lps[r, j]) if want_lp else None)
+                self.last_tokens[i] = t
+            max_emitted = max(max_emitted, len(emitted))
+        return max_emitted
 
     def _stop_set(self, req: Request) -> set:
         stop_ids = set(req.params.stop_token_ids)

@@ -668,6 +668,37 @@ class TransformerLM:
             x, (true_lens - 1)[:, None, None].astype(jnp.int32), axis=1)[:, 0]
         return cache, self._logits(params, last), last
 
+    def verify_window(self, params, cache: KVCache, tokens, true_lens,
+                      page_tables, start_pos, adapter_ids=None):
+        """Speculative-decoding verification: run a small window of
+        proposed tokens (chunked-prefill machinery — paged history +
+        causal window attention, KV written in place) and return the
+        GREEDY next token and its model logprob at EVERY window
+        position.
+
+        tokens: [B, W] (= [last_emitted, proposal...], -pad);
+        true_lens: [B] valid window tokens (0 skips a slot — its writes
+        mask to the null page); start_pos: [B] absolute position of the
+        window start.  Returns (cache, targets [B, W] int32,
+        lps [B, W] f32) — the [B, W, V] logits never leave the device.
+        """
+        from kaito_tpu.engine.sampler import chosen_logprob
+
+        B, W = tokens.shape
+        rel = jnp.broadcast_to(jnp.arange(W, dtype=jnp.int32), (B, W))
+        positions = rel + start_pos[:, None]
+        x = self._embed(params, tokens)
+        x, cache = self._run_layers(
+            params, cache, x, "prefill", positions=positions,
+            page_tables=page_tables, lengths=true_lens, true_lens=true_lens,
+            active=None, start_pos=start_pos, adapter_ids=adapter_ids)
+        x = self._norm(x, params, "final_norm")
+        logits = self._logits(params, x).astype(jnp.float32)   # [B, W, V]
+        targets = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        flat_lp = chosen_logprob(logits.reshape(B * W, -1),
+                                 targets.reshape(B * W))
+        return cache, targets, flat_lp.reshape(B, W)
+
     def decode(self, params, cache: KVCache, tokens, positions, page_tables,
                active=None, adapter_ids=None):
         """One decode step for a batch of slots.

@@ -756,6 +756,11 @@ def main(argv=None):
                          "(0 disables; reference contract "
                          "inference_api.py:503-556)")
     ap.add_argument("--max-queue-len", type=int, default=256)
+    ap.add_argument("--speculative-ngram", type=int,
+                    default=int(os.environ.get("KAITO_SPEC_NGRAM", "0")),
+                    help="prompt-lookup speculative decoding: propose up "
+                         "to N tokens per step (0 = off; exact greedy "
+                         "equivalence)")
     args = ap.parse_args(argv)
 
     import jax
@@ -788,6 +793,7 @@ def main(argv=None):
             args.kaito_kv_cache_cpu_memory_utilization
             * os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES")),
         max_queue_len=args.max_queue_len,
+        speculative_ngram=args.speculative_ngram,
     )
     if args.kaito_config_file:
         cfg = load_config_file(cfg, args.kaito_config_file)
