@@ -154,6 +154,9 @@ class DataParallelEngine:
     def submit_with_kv(self, *a, **kw):
         raise RuntimeError("P/D KV import requires data_parallel=1")
 
+    def submit_with_kv_chunked(self, *a, **kw):
+        raise RuntimeError("P/D KV import requires data_parallel=1")
+
     @property
     def kv_exports(self):
         return self.engines[0].kv_exports

@@ -87,6 +87,7 @@ class Request:
     # P/D disaggregation (kaito_tpu.engine.pd)
     export_kv: bool = False                # prefill role: stage KV on finish
     kv_import: Optional[tuple] = None      # decode role: (meta, payload, first_token)
+    kv_chunked: Optional[object] = None    # decode role: pd.ChunkedImport
     submit_time: float = field(default_factory=time.monotonic)
     first_token_time: Optional[float] = None
     finish_time: Optional[float] = None
@@ -145,6 +146,7 @@ class _Slot:
     position: int = 0          # next token position (== current length)
     remaining: int = 0
     prefilling: bool = False
+    importing: bool = False    # PD decode role: KV chunks still landing
     prefill_pos: int = 0       # prompt tokens written so far (incl. cached)
     prefill_tokens: list[int] = field(default_factory=list)
     seq: int = 0               # admission order (newest preempts first)
@@ -916,17 +918,41 @@ class InferenceEngine:
                        req_id: Optional[str] = None) -> Request:
         """Decode-role entry: continue a prefilled request from
         transferred KV pages."""
-        if len(prompt_tokens) >= self.cfg.max_model_len:
-            raise ValueError(f"prompt length {len(prompt_tokens)} exceeds "
-                             f"max_model_len {self.cfg.max_model_len}")
-        if params.max_tokens < 1:
-            raise ValueError(f"max_tokens must be >= 1, got {params.max_tokens}")
+        self._validate_submit(prompt_tokens, params)
         if meta.get("model") not in ("", None, self.md.name):
             raise ValueError(f"KV transfer model mismatch: {meta.get('model')} "
                              f"!= {self.md.name}")
         req = Request(req_id or f"pd-{self.counters['requests_total']}",
                       list(prompt_tokens), params,
                       kv_import=(meta, payload, first_token))
+        with self._lock:
+            self.counters["requests_total"] += 1
+            self._waiting_count += 1
+            self.waiting.append(req)
+        self._wake.set()
+        return req
+
+    def submit_with_kv_chunked(self, prompt_tokens: list[int],
+                               first_token: int, meta: dict, plans,
+                               params: SamplingParams,
+                               req_id: Optional[str] = None,
+                               deadline_s: float = 120.0):
+        """Decode-role entry for the CHUNKED transfer path: the request
+        is admitted immediately and its KV chunks are scattered by the
+        scheduler loop as the caller ``feed``s them into the returned
+        request's ``kv_chunked`` (overlapping the transfer with decode
+        of other requests).  Returns the Request; the caller feeds
+        ``req.kv_chunked.feed(i, payload)`` for every chunk."""
+        from kaito_tpu.engine.pd import ChunkedImport
+
+        self._validate_submit(prompt_tokens, params)
+        if meta.get("model") not in ("", None, self.md.name):
+            raise ValueError(f"KV transfer model mismatch: {meta.get('model')} "
+                             f"!= {self.md.name}")
+        req = Request(req_id or f"pd-{self.counters['requests_total']}",
+                      list(prompt_tokens), params,
+                      kv_chunked=ChunkedImport(meta, list(plans), first_token,
+                                               deadline_s=deadline_s))
         with self._lock:
             self.counters["requests_total"] += 1
             self._waiting_count += 1
@@ -1009,7 +1035,8 @@ class InferenceEngine:
         if self.prefix_cache is not None:
             # adapter KV must never enter the shared tree (it embeds the
             # adapter's k/v deltas); imports are foreign bytes
-            exclusive = req.kv_import is not None or bool(req.adapter)
+            exclusive = (req.kv_import is not None
+                         or req.kv_chunked is not None or bool(req.adapter))
             tokens = [] if exclusive else req.resume_tokens()[:slot.written]
             if commit and not exclusive:
                 self.prefix_cache.release(tokens, slot.pages)
@@ -1029,6 +1056,7 @@ class InferenceEngine:
         slot.request = None
         slot.pages = []
         slot.prefilling = False
+        slot.importing = False
         slot.prefill_tokens = []
         slot.prefill_pos = 0
         slot.position = 0
@@ -1118,6 +1146,8 @@ class InferenceEngine:
             la = self._decode_lookahead()
             self._ensure_decode_pages(la)
         did = self._admit_new()
+        if self._advance_imports():
+            did = True
         decoding = bool(self.active.any())
         steps_run = 0
         if decoding:
@@ -1196,6 +1226,7 @@ class InferenceEngine:
         n = len(tokens)
         cached = 0
         has_spill = (self.host_kv is not None and req.kv_import is None
+                     and req.kv_chunked is None
                      and self.host_kv.has(req.req_id))
         # leave one page of headroom per decoding slot so admissions
         # don't trigger immediate grow-preempt churn
@@ -1211,8 +1242,9 @@ class InferenceEngine:
             # adapter): all acquire EXCLUSIVE pages (empty-token acquire
             # shares nothing) so they neither overwrite shared pages nor
             # inherit a cached prefix computed under different weights
-            acquire_tokens = [] if (req.kv_import is not None or has_spill
-                                    or req.adapter) else tokens
+            acquire_tokens = [] if (req.kv_import is not None
+                                    or req.kv_chunked is not None
+                                    or has_spill or req.adapter) else tokens
             res = self.prefix_cache.acquire(acquire_tokens, n + 1)
             if res is None:
                 self._requeue_front(req)
@@ -1275,6 +1307,9 @@ class InferenceEngine:
             if req.kv_import is not None:
                 self._start_imported(req, free_slot)
                 return True
+            if req.kv_chunked is not None:
+                self._start_chunked_import(req, free_slot)
+                return True
             if has_spill and self._try_restore(req, free_slot):
                 return True       # resumed from host pages, no prefill
             if cached:
@@ -1300,11 +1335,59 @@ class InferenceEngine:
             req.prompt_counted = True
         self._begin_decode(free_slot, first, n)
 
+    def _start_chunked_import(self, req: Request, free_slot: int):
+        """Decode-role start, chunked path: the slot parks in the
+        ``importing`` state; ``_advance_imports`` scatters chunks as
+        they arrive and begins decode when the last one lands."""
+        slot = self.slots[free_slot]
+        slot.importing = True
+        n = len(req.prompt_tokens)
+        if not req.prompt_counted:
+            self.counters["prompt_tokens_total"] += n
+            req.prompt_counted = True
+
+    def _advance_imports(self) -> bool:
+        """Assemble arrived KV chunks for importing slots into host
+        buffers — bounded work per call so a large transfer never
+        stalls the decode cadence of other requests — then ONE device
+        scatter and the decode transition when the last chunk lands."""
+        from kaito_tpu.engine.pd import import_arrays
+
+        did = False
+        for i, slot in enumerate(self.slots):
+            req = slot.request
+            if req is None or not slot.importing:
+                continue
+            ci = req.kv_chunked
+            err = ci.error
+            if err is None:
+                try:
+                    if ci.assemble():
+                        did = True
+                    if ci.complete:
+                        n = len(req.prompt_tokens)
+                        n_pages = -(-n // self.cfg.page_size)
+                        k, v = ci.full_arrays()
+                        self.cache = import_arrays(
+                            self.cache, slot.pages[:n_pages], k, v)
+                        slot.importing = False
+                        self._begin_decode(i, ci.first_token, n)
+                        did = True
+                except Exception as e:
+                    err = f"{type(e).__name__}: {e}"
+            if err is not None:
+                logger.warning("KV import failed for %s: %s", req.req_id, err)
+                self._evict_slot(i, commit=False)
+                self._fail_request(req)
+                did = True
+        return did
+
     def _advance_prefills(self) -> bool:
         """Run ONE bounded prefill chunk for one staged slot
         (round-robin), completing admission when the prompt is done."""
         idxs = [i for i, s in enumerate(self.slots)
-                if s.request is not None and s.prefilling]
+                if s.request is not None and s.prefilling
+                and not s.importing]
         if not idxs:
             return False
         i = idxs[self._prefill_rr % len(idxs)]
@@ -1440,6 +1523,7 @@ class InferenceEngine:
         # release uncommitted — they must never enter the radix tree
         self._evict_slot(victim, commit=True)
         req.kv_import = None     # imported KV is consumed; resume recomputes
+        req.kv_chunked = None
         if not will_requeue:
             # the sequence already fills the whole pool: it cannot be
             # re-admitted (resume needs more pages than exist), and all
@@ -1887,15 +1971,17 @@ class InferenceEngine:
             req.finish_reason = "stop" if token in stop_ids else "length"
             req.finish_time = time.monotonic()
             if req.export_kv:
-                from kaito_tpu.engine.pd import _Export, export_kv
+                from kaito_tpu.engine.pd import stage_export
 
+                # engine thread does only the on-device gather; a
+                # background copier drains to host chunk-by-chunk so
+                # the decode cadence never stalls on a D2H of the
+                # whole request (pd.py design note)
                 n = len(req.prompt_tokens)
                 n_pages = -(-n // self.cfg.page_size)
-                meta, payload = export_kv(self.cache, slot.pages[:n_pages])
-                meta["n_tokens"] = n
-                meta["model"] = self.md.name
-                self.kv_exports.put(req.req_id, _Export(
-                    meta=meta, payload=payload,
+                self.kv_exports.put(req.req_id, stage_export(
+                    self.cache, slot.pages[:n_pages], n_tokens=n,
+                    model=self.md.name,
                     prompt_tokens=list(req.prompt_tokens),
                     first_token=req.output_tokens[0]))
             req.out.put(None)

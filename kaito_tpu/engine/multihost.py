@@ -152,6 +152,12 @@ class MultiHostEngine(InferenceEngine):
             self._abort_requested.add(req.req_id)
         self._wake.set()
 
+    def submit_with_kv_chunked(self, *a, **kw):
+        raise RuntimeError(
+            "P/D KV import is not supported on a multi-host engine: the "
+            "request stream is broadcast at step boundaries and a "
+            "leader-only import would diverge the replicas")
+
     def submit_with_kv(self, *a, **kw):
         raise RuntimeError("PD KV import is single-host per role")
 

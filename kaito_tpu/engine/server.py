@@ -125,7 +125,14 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
         elif self.path.startswith("/pd/kv/"):
-            self._pd_kv(self.path[len("/pd/kv/"):])
+            rest = self.path[len("/pd/kv/"):]
+            if rest.endswith("/meta"):
+                self._pd_kv_meta(rest[:-len("/meta")])
+            elif "/chunk/" in rest:
+                rid, _, idx = rest.partition("/chunk/")
+                self._pd_kv_chunk(rid, idx)
+            else:
+                self._pd_kv(rest)
         elif self.path in ("/ui", "/ui/"):
             # single-pod demo: the DemoUI chat page served in-process
             # (the standalone proxy pod lives in kaito_tpu/ui)
@@ -139,6 +146,18 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                 models.append({"id": name, "object": "model",
                                "owned_by": "kaito-tpu", "parent": st.model_name})
             self._json(200, {"object": "list", "data": models})
+        else:
+            self._error(404, f"no route {self.path}")
+
+    def do_DELETE(self):
+        if self.path.startswith("/pd/kv/"):
+            # decode side declined the transfer (below break-even):
+            # release the staged export instead of waiting out the TTL
+            if not self._pd_enabled():
+                return self._error(403, "P/D disaggregation disabled")
+            rid = self.path[len("/pd/kv/"):]
+            gone = self.state.engine.kv_exports.pop(rid) is not None
+            self._json(200 if gone else 404, {"released": gone})
         else:
             self._error(404, f"no route {self.path}")
 
@@ -263,6 +282,8 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                          "prompt_tokens": tokens})
 
     def _pd_kv(self, req_id: str):
+        """Legacy single-blob pull (small transfers / compat); the
+        chunked endpoints below are the serving path."""
         if not self._pd_enabled():
             return self._error(403, "P/D disaggregation disabled on this pod")
         from kaito_tpu.engine.pd import pack_transfer
@@ -270,18 +291,66 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         exp = self.state.engine.kv_exports.pop(req_id)
         if exp is None:
             return self._error(404, f"no staged KV for {req_id}")
-        blob = pack_transfer(exp.meta, exp.payload)
+        try:
+            blob = pack_transfer(exp.meta, exp.whole_blob())
+        except Exception as e:
+            return self._error(500, f"KV export drain failed: {e}")
         self.send_response(200)
         self.send_header("Content-Type", "application/octet-stream")
         self.send_header("Content-Length", str(len(blob)))
         self.end_headers()
         self.wfile.write(blob)
 
+    def _pd_kv_meta(self, req_id: str):
+        """Chunk-plan handshake: meta (shape/dtype/model/chunk plans)
+        without consuming anything."""
+        if not self._pd_enabled():
+            return self._error(403, "P/D disaggregation disabled on this pod")
+        exp = self.state.engine.kv_exports.get(req_id)
+        if exp is None:
+            return self._error(404, f"no staged KV for {req_id}")
+        self._json(200, {"meta": exp.meta, "n_chunks": exp.n_chunks})
+
+    def _pd_kv_chunk(self, req_id: str, idx: str):
+        """Pull ONE chunk; blocks until the background copier has
+        landed it (overlapping the puller with the remaining D2H
+        copies).  Chunks are consumed on read; the staged entry drops
+        once every chunk is served."""
+        if not self._pd_enabled():
+            return self._error(403, "P/D disaggregation disabled on this pod")
+        reg = self.state.engine.kv_exports
+        exp = reg.get(req_id)
+        if exp is None:
+            return self._error(404, f"no staged KV for {req_id}")
+        try:
+            data = exp.get_chunk(int(idx))
+        except (IndexError, ValueError) as e:
+            return self._error(400, str(e))
+        except KeyError as e:
+            return self._error(410, str(e))
+        except Exception as e:
+            return self._error(500, f"chunk read failed: {e}")
+        reg.drop_served(req_id)
+        self.send_response(200)
+        self.send_header("Content-Type", "application/octet-stream")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
     def _submit_with_transfer(self, kv_src: dict, params):
-        """Pull staged KV from the prefill pod and continue decoding."""
+        """Continue decoding from a remote prefill's KV.
+
+        Chunked overlapped pull: a handshake fetches the chunk plan,
+        the request is admitted immediately, and a background puller
+        streams chunks into the engine (which scatters them between
+        decode steps).  For prompts below the transfer-vs-recompute
+        break-even (pd.should_transfer), the KV move is skipped
+        entirely and the prompt prefills locally — cheaper than the
+        wire for short prompts.  ``force: true`` in the kv_transfer
+        body pins the transfer path (tests / operator override)."""
         import urllib.request
 
-        from kaito_tpu.engine.pd import unpack_transfer
+        from kaito_tpu.engine.pd import ChunkPlan, should_transfer
 
         if not self._pd_enabled():
             self._error(403, "P/D disaggregation disabled on this pod")
@@ -295,18 +364,64 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         if allow and not any(url.startswith(pref) for pref in allow):
             self._error(403, f"kv_transfer source {url!r} not in allowlist")
             return None
-        try:
-            with urllib.request.urlopen(f"{url}/pd/kv/{req_id}",
-                                        timeout=120) as r:
-                meta, payload = unpack_transfer(r.read())
-        except Exception as e:
-            self._error(502, f"KV pull from {url} failed: {e}")
-            return None
         prompt_tokens = kv_src.get("prompt_tokens") or []
         first = int(kv_src.get("first_token", 0))
-        return self.state.engine.submit_with_kv(
-            prompt_tokens, first, meta, payload, params,
-            req_id=f"cmpl-{uuid.uuid4().hex[:20]}")
+        eng = self.state.engine
+        cache = getattr(eng, "cache", None)
+        kv_itemsize = cache.k.dtype.itemsize if cache is not None else 2
+        # the recompute fallback re-samples the first token locally, so
+        # it is only equivalence-preserving for greedy requests; sampled
+        # requests always honor the prefill pod's first_token via the
+        # transfer path
+        if (not kv_src.get("force") and params.temperature == 0.0
+                and not should_transfer(
+                    len(prompt_tokens), eng.md.arch, kv_itemsize)):
+            # below break-even: local prefill beats the wire.  Release
+            # the staged export so the prefill pod doesn't hold it to
+            # TTL, then admit as a plain request (greedy output is
+            # identical; the prefill pod's first token is re-derived).
+            logger.info("kv_transfer below break-even (%d tokens); "
+                        "recomputing locally", len(prompt_tokens))
+            try:
+                urllib.request.urlopen(urllib.request.Request(
+                    f"{url}/pd/kv/{req_id}", method="DELETE"), timeout=10)
+            except Exception:
+                pass   # TTL reclaims it
+            return eng.submit(prompt_tokens, params,
+                              req_id=f"cmpl-{uuid.uuid4().hex[:20]}")
+        try:
+            with urllib.request.urlopen(f"{url}/pd/kv/{req_id}/meta",
+                                        timeout=30) as r:
+                hs = json.loads(r.read())
+            meta = hs["meta"]
+            plans = [ChunkPlan.from_json(c) for c in meta["chunks"]]
+        except Exception as e:
+            self._error(502, f"KV meta pull from {url} failed: {e}")
+            return None
+        try:
+            req = eng.submit_with_kv_chunked(
+                prompt_tokens, first, meta, plans, params,
+                req_id=f"cmpl-{uuid.uuid4().hex[:20]}")
+        except ValueError as e:
+            self._error(400, str(e))
+            return None
+
+        def pull():
+            ci = req.kv_chunked
+            try:
+                for i in range(len(plans)):
+                    with urllib.request.urlopen(
+                            f"{url}/pd/kv/{req_id}/chunk/{i}",
+                            timeout=120) as r:
+                        ci.feed(i, r.read())
+                    eng._wake.set()
+            except Exception as e:
+                ci.set_error(f"chunk pull from {url} failed: {e}")
+                eng._wake.set()
+
+        threading.Thread(target=pull, daemon=True,
+                         name="pd-chunk-puller").start()
+        return req
 
     # ---------------- generation ----------------
 
@@ -716,6 +831,9 @@ def load_config_file(cfg: EngineConfig, path: str) -> EngineConfig:
 
 
 def main(argv=None):
+    from kaito_tpu.utils.platform import apply_platform_env
+
+    apply_platform_env()
     ap = argparse.ArgumentParser(prog="kaito-tpu-serve")
     ap.add_argument("--model", default="tiny-llama-test")
     ap.add_argument("--port", type=int, default=5000)

@@ -56,6 +56,9 @@ def parse_args(argv=None) -> TrainConfig:
 
 
 def main(argv=None):
+    from kaito_tpu.utils.platform import apply_platform_env
+
+    apply_platform_env()
     logging.basicConfig(level=logging.INFO)
     cfg = parse_args(argv)
     import jax

@@ -1,0 +1,26 @@
+"""Make the JAX_PLATFORMS env var authoritative for entrypoints.
+
+Some images pre-seed ``jax_platforms`` via a sitecustomize PJRT
+registration (e.g. a TPU-tunnel plugin setting "axon,cpu"), which wins
+over the environment variable.  Every standalone entrypoint (serving
+server, tuning CLI, benchmark probe) calls this before touching a
+device so ``JAX_PLATFORMS=cpu python -m kaito_tpu.engine.server ...``
+means what it says — matching the reference's expectation that the
+runtime honors its launcher's device selection
+(presets/workspace/inference/vllm/inference_api.py device args).
+"""
+
+import os
+
+
+def apply_platform_env() -> None:
+    """If JAX_PLATFORMS is set, force jax's platform config to it."""
+    plat = os.environ.get("JAX_PLATFORMS")
+    if not plat:
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", plat)
+    except Exception:   # backends already initialized: nothing to do
+        pass

@@ -211,7 +211,7 @@ def test_import_admission_mid_window_decodes_correctly():
 
     def race_admit():
         state["imp"] = eng.submit_with_kv(prompt, first, export.meta,
-                                          export.payload, p)
+                                          export.whole_blob(), p)
         eng._admit_new = orig_admit    # one-shot
         return orig_admit()
 

@@ -58,16 +58,122 @@ def test_pd_handoff_matches_monolithic(pd_pair):
     assert pre["n_tokens"] > 0
     assert len(prefill_engine.kv_exports) == 1
 
-    # 2) decode pod pulls the KV and continues
+    # 2) decode pod pulls the KV (chunked path, forced past the
+    # break-even model — this prompt is far below it) and continues
     out = _post(decode_url, "/v1/completions", {
         "prompt": prompt, "max_tokens": 8, "temperature": 0.0,
         "kv_transfer": {"source_url": prefill_url, "req_id": pre["req_id"],
                         "prompt_tokens": pre["prompt_tokens"],
-                        "first_token": pre["first_token"]}})
+                        "first_token": pre["first_token"],
+                        "force": True}})
     text = out["choices"][0]["text"]
     assert text == mono_text
-    # staged KV is consumed
+    # staged KV is consumed (every chunk served -> entry dropped)
     assert len(prefill_engine.kv_exports) == 0
+
+
+def test_pd_breakeven_recompute_fallback(pd_pair):
+    """Below the transfer-vs-recompute break-even the decode pod skips
+    the wire, prefills locally (same greedy output), and releases the
+    staged export on the prefill pod via DELETE."""
+    prefill_url, decode_url, prefill_engine, _ = pd_pair
+    prompt = "short prompt recompute"
+    mono = _post(decode_url, "/v1/completions", {
+        "prompt": prompt, "max_tokens": 6, "temperature": 0.0})
+    pre = _post(prefill_url, "/pd/prefill", {"prompt": prompt,
+                                             "temperature": 0.0})
+    assert len(prefill_engine.kv_exports) == 1
+    out = _post(decode_url, "/v1/completions", {
+        "prompt": prompt, "max_tokens": 6, "temperature": 0.0,
+        "kv_transfer": {"source_url": prefill_url, "req_id": pre["req_id"],
+                        "prompt_tokens": pre["prompt_tokens"],
+                        "first_token": pre["first_token"]}})
+    assert out["choices"][0]["text"] == mono["choices"][0]["text"]
+    # DELETE released the staged export without a pull
+    assert len(prefill_engine.kv_exports) == 0
+
+
+def test_pd_chunked_token_parity():
+    """Engine-level greedy parity for the CHUNKED import path, on raw
+    token IDs (the HTTP text comparison can't see them): producer
+    stages a chunked export, consumer feeds the chunks out of order,
+    and the continuation must match a monolithic engine exactly."""
+    from kaito_tpu.engine.pd import ChunkPlan
+
+    def mk():
+        return InferenceEngine(EngineConfig(**CFG))
+
+    prompt = list(range(2, 40))
+    p = SamplingParams(max_tokens=16, temperature=0.0, ignore_eos=True)
+    ref = mk()
+    ref.start()
+    ref_out = list(ref.submit(prompt, p).stream())
+    ref.stop()
+
+    prod = mk()
+    prod.start()
+    pre = prod.submit(prompt, SamplingParams(max_tokens=1, temperature=0.0,
+                                             ignore_eos=True),
+                      export_kv=True)
+    first = list(pre.stream())[0]
+    staged = prod.kv_exports.pop(pre.req_id)
+    staged.wait_all()
+    # re-plan into several small chunks so the multi-chunk path is real
+    fine = []
+    for pl in staged.plans:
+        for layer in range(pl.layer_lo, pl.layer_hi):
+            fine.append(ChunkPlan(layer, layer + 1, pl.page_lo, pl.page_hi))
+    assert len(fine) > 1
+
+    cons = mk()
+    cons.start()
+    try:
+        meta = dict(staged.meta)
+        meta["chunks"] = [pl.to_json() for pl in fine]
+        req = cons.submit_with_kv_chunked(prompt, first, meta, fine, p)
+        # feed chunks out of order (arrival order is not plan order)
+        import numpy as np
+
+        from kaito_tpu.engine.pd import deserialize_chunk, serialize_chunk
+
+        whole_k, whole_v = deserialize_chunk(staged.whole_blob())
+        order = list(range(len(fine)))[::-1]
+        for i in order:
+            pl = fine[i]
+            req.kv_chunked.feed(i, serialize_chunk(
+                np.ascontiguousarray(
+                    whole_k[pl.layer_lo:pl.layer_hi, pl.page_lo:pl.page_hi]),
+                np.ascontiguousarray(
+                    whole_v[pl.layer_lo:pl.layer_hi, pl.page_lo:pl.page_hi])))
+            cons._wake.set()
+        list(req.stream())
+        assert req.finish_reason != "error"
+        assert list(req.output_tokens) == ref_out
+    finally:
+        cons.stop()
+        prod.stop()
+
+
+def test_pd_chunk_endpoints(pd_pair):
+    """Chunked wire: /meta returns the plan; each /chunk/{i} serves
+    once (second read is 410/404 after the entry drops)."""
+    prefill_url, _, prefill_engine, _ = pd_pair
+    pre = _post(prefill_url, "/pd/prefill", {"prompt": "chunk endpoint test",
+                                             "temperature": 0.0})
+    hs = json.loads(urllib.request.urlopen(
+        f"{prefill_url}/pd/kv/{pre['req_id']}/meta", timeout=30).read())
+    assert hs["n_chunks"] >= 1
+    assert len(hs["meta"]["chunks"]) == hs["n_chunks"]
+    for i in range(hs["n_chunks"]):
+        data = urllib.request.urlopen(
+            f"{prefill_url}/pd/kv/{pre['req_id']}/chunk/{i}",
+            timeout=30).read()
+        assert len(data) > 16
+    assert len(prefill_engine.kv_exports) == 0
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(
+            f"{prefill_url}/pd/kv/{pre['req_id']}/chunk/0", timeout=30)
+    assert e.value.code in (404, 410)
 
 
 def test_pd_kv_pull_404_after_consume(pd_pair):
@@ -90,6 +196,6 @@ def test_pd_decode_rejects_bad_source(pd_pair):
             "prompt": "x", "max_tokens": 2,
             "kv_transfer": {"source_url": "http://127.0.0.1:1",
                             "req_id": "nope", "prompt_tokens": [1],
-                            "first_token": 0}})
+                            "first_token": 0, "force": True}})
     assert e.value.code == 502
 
