@@ -187,10 +187,6 @@ class InferenceEngine:
         self.tokenizer = load_tokenizer(self.md.hf_id, arch.vocab_size)
         self.pp_exec = None
         if cfg.pipeline_parallel > 1:
-            if cfg.expert_parallel > 1:
-                raise ValueError("pipeline_parallel does not compose with "
-                                 "expert parallelism (MoE models are "
-                                 "served TP x EP)")
             if cfg.pd_enabled:
                 raise ValueError("P/D disaggregation is not supported with "
                                  "pipeline-parallel serving")
@@ -260,43 +256,34 @@ class InferenceEngine:
         self.adapter_index: dict[str, int] = {}
         self.adapters_merged = False
         if cfg.adapters_dir:
-            if self.pp_exec is not None:
-                # stacked buffers would need stage-splitting alongside
-                # the layer stacks; PP keeps merge-into-base semantics
-                from kaito_tpu.engine.adapters import apply_adapters_to_params
+            from kaito_tpu.engine.adapters import (
+                apply_adapters_to_params,
+                discover_adapters,
+                load_adapter_stacks,
+            )
 
-                logger.warning("PP engine: adapters merge into base "
-                               "weights (per-request routing covers "
-                               "single-chip and TP engines)")
+            serve_lora, self.adapter_index = load_adapter_stacks(
+                self.model, cfg.adapters_dir, self.md.name)
+            if serve_lora:
+                if self.mesh is not None:
+                    # adapter factors are tiny; replicate across the
+                    # TP mesh so the scan body sees local buffers
+                    from jax.sharding import NamedSharding
+                    from jax.sharding import PartitionSpec as P
+
+                    serve_lora = jax.device_put(
+                        serve_lora, NamedSharding(self.mesh, P()))
+                # under PP the stacks stage-split alongside the layer
+                # stacks in stage_params below — per-request multi-LoRA
+                # keeps working at every parallelism tier
+                self.params = {**self.params, "serve_lora": serve_lora}
+            elif discover_adapters(cfg.adapters_dir):
+                # MLA or no routable targets: keep the round-1
+                # merge-into-base behavior so advertised adapters
+                # still take effect (selection routes to base)
                 self.params = apply_adapters_to_params(
                     self.model, self.params, cfg.adapters_dir)
                 self.adapters_merged = True
-            else:
-                from kaito_tpu.engine.adapters import (
-                    apply_adapters_to_params,
-                    discover_adapters,
-                    load_adapter_stacks,
-                )
-
-                serve_lora, self.adapter_index = load_adapter_stacks(
-                    self.model, cfg.adapters_dir, self.md.name)
-                if serve_lora:
-                    if self.mesh is not None:
-                        # adapter factors are tiny; replicate across the
-                        # TP mesh so the scan body sees local buffers
-                        from jax.sharding import NamedSharding
-                        from jax.sharding import PartitionSpec as P
-
-                        serve_lora = jax.device_put(
-                            serve_lora, NamedSharding(self.mesh, P()))
-                    self.params = {**self.params, "serve_lora": serve_lora}
-                elif discover_adapters(cfg.adapters_dir):
-                    # MLA or no routable targets: keep the round-1
-                    # merge-into-base behavior so advertised adapters
-                    # still take effect (selection routes to base)
-                    self.params = apply_adapters_to_params(
-                        self.model, self.params, cfg.adapters_dir)
-                    self.adapters_merged = True
         if self.pp_exec is not None:
             self.params = self.pp_exec.stage_params(self.params)
         self.prefix_cache = None
@@ -433,13 +420,21 @@ class InferenceEngine:
 
         pp = self.cfg.pipeline_parallel
         tp = max(1, self.cfg.tensor_parallel)
+        ep = max(1, self.cfg.expert_parallel)
+        if ep > 1 and (self.md.arch.num_experts < ep
+                       or self.md.arch.num_experts % ep):
+            raise ValueError(f"expert_parallel={ep} must divide the "
+                             f"{self.md.arch.num_experts} experts")
         devices = jax.devices()
-        if len(devices) < pp * tp:
-            raise ValueError(f"pipeline_parallel={pp} x tensor_parallel="
-                             f"{tp} but only {len(devices)} devices visible")
-        if tp > 1:
-            mesh = Mesh(np.array(devices[:pp * tp]).reshape(pp, tp),
-                        ("pipeline", "tensor"))
+        if len(devices) < pp * ep * tp:
+            raise ValueError(f"pipeline_parallel={pp} x expert_parallel={ep}"
+                             f" x tensor_parallel={tp} but only "
+                             f"{len(devices)} devices visible")
+        if ep * tp > 1:
+            # pipeline outermost (the DCN/process axis); EP and TP ride
+            # ICI inside each stage, mirroring the flat engine's mesh
+            mesh = Mesh(np.array(devices[:pp * ep * tp]).reshape(pp, ep, tp),
+                        ("pipeline", "expert", "tensor"))
         else:
             mesh = Mesh(np.array(devices[:pp]), ("pipeline",))
         if self.cfg.pp_microbatches < 1:
@@ -584,7 +579,10 @@ class InferenceEngine:
                 out_shardings=self._param_shardings())(
                     jax.random.PRNGKey(self.cfg.seed))
         else:
-            with jax.default_device(jax.devices()[0]):
+            # local_devices, not devices: in a multi-process cluster
+            # (PP over DCN) global device 0 is unaddressable on workers,
+            # and the staging reshape needs a fully-addressable array
+            with jax.default_device(jax.local_devices()[0]):
                 params = jax.jit(self.model.init_params)(
                     jax.random.PRNGKey(self.cfg.seed))
         jax.block_until_ready(params)
@@ -625,12 +623,16 @@ class InferenceEngine:
         engine's own device: under in-engine DP, group N's pool must
         budget against its own chips, not device 0's already-occupied
         HBM."""
-        if self.mesh is not None:
-            dev = self.mesh.devices.flat[0]
-        elif self.pp_exec is not None:
-            dev = self.pp_exec.mesh.devices.flat[0]
+        meshes = (self.mesh, self.pp_exec.mesh if self.pp_exec else None)
+        mesh = next((m for m in meshes if m is not None), None)
+        if mesh is not None:
+            # first ADDRESSABLE mesh device: on a multi-process mesh,
+            # flat[0] belongs to process 0 and workers can't stat it
+            dev = next((d for d in mesh.devices.flat
+                        if d.process_index == jax.process_index()),
+                       jax.local_devices()[0])
         else:
-            dev = jax.devices()[0]
+            dev = jax.local_devices()[0]
         bpt = self.md.kv_bytes_per_token(jnp.dtype(self.cfg.kv_dtype).itemsize)
         # sizing runs AFTER params are resident (and quantized), so the
         # ACTUAL weight bytes are known — no dtype/quant estimation
@@ -669,7 +671,8 @@ class InferenceEngine:
                         tokens, positions, page_tables, active, adapter_ids):
             if pp_decode is not None:
                 cache, logits = pp_decode(params, cache, tokens, positions,
-                                          page_tables, active)
+                                          page_tables, active,
+                                          adapter_ids=adapter_ids)
             else:
                 cache, logits = model.decode(params, cache, tokens, positions,
                                              page_tables, active,
@@ -762,7 +765,7 @@ class InferenceEngine:
                              adapter_ids):
                 if pp_prefill is not None:
                     return pp_prefill(params, cache, tokens, true_lens,
-                                      page_tables)
+                                      page_tables, adapter_ids=adapter_ids)
                 cache, logits, _ = model.prefill(params, cache, tokens,
                                                  true_lens, page_tables,
                                                  adapter_ids=adapter_ids)
@@ -785,7 +788,8 @@ class InferenceEngine:
                             start_pos, adapter_ids):
                 if pp_prefill is not None:
                     return pp_prefill(params, cache, tokens, true_lens,
-                                      page_tables, start_pos)
+                                      page_tables, start_pos,
+                                      adapter_ids=adapter_ids)
                 cache, logits, _ = model.prefill(params, cache, tokens,
                                                  true_lens, page_tables,
                                                  start_pos=start_pos,

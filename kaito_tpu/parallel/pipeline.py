@@ -30,17 +30,26 @@ from kaito_tpu.tuning.train_step import cross_entropy_loss
 
 def split_stage_params(model: TransformerLM, params: dict, num_stages: int) -> dict:
     """Reshape the scanned layer stacks [L, ...] -> [P, L/P, ...] so the
-    leading axis shards over the pipeline mesh axis."""
-    (group,) = model.groups  # dense single group (v1 scope)
+    leading axis shards over the pipeline mesh axis.  The per-request
+    LoRA stacks (``serve_lora``, [L, n_adapters+1, ...]) ride the same
+    layer scan and split identically, so multi-adapter serving keeps
+    working under PP (no merge-into-base)."""
+    (group,) = model.groups  # single homogeneous group (v1 scope)
     L = model.arch.num_layers
     if L % num_stages:
         raise ValueError(f"{L} layers do not split into {num_stages} stages")
+
+    def split(v):
+        return v.reshape((num_stages, L // num_stages) + v.shape[1:])
+
     out = dict(params)
     out[group.name] = {
-        k: jax.tree.map(
-            lambda v: v.reshape((num_stages, L // num_stages) + v.shape[1:]),
-            sub)
+        k: jax.tree.map(split, sub)
         for k, sub in params[group.name].items()}
+    if "serve_lora" in params:
+        out["serve_lora"] = {
+            g: jax.tree.map(split, sub)
+            for g, sub in params["serve_lora"].items()}
     return out
 
 

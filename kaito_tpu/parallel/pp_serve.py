@@ -26,8 +26,13 @@ shard_map is *partial-manual* — only the pipeline axis is manual
 inserts the TP collectives inside each stage exactly as it does for
 the flat-TP engine.
 
-Scope: dense single-group models (no MoE/MLA), global attention
-(no sliding-window scan flags).
+EP composes inside each stage the same way TP does (the expert axis
+stays auto, so each stage's expert stacks place over its own devices),
+and per-request LoRA stacks split alongside the layer stacks (no
+merge-into-base under PP).
+
+Scope: homogeneous single-group layer stacks (no MLA), global
+attention (no sliding-window scan flags).
 """
 
 from __future__ import annotations
@@ -50,17 +55,27 @@ class PipelineServeExecutor:
 
     def __init__(self, model: TransformerLM, mesh: Mesh,
                  num_microbatches: int = 4, axis: str = "pipeline"):
-        if model.is_mla or model.arch.num_experts > 0:
-            raise ValueError("pipeline-parallel serving v1 covers dense "
-                             "models only (no MoE/MLA)")
+        if model.is_mla:
+            raise ValueError("pipeline-parallel serving does not cover "
+                             "MLA models yet")
         if model.arch.sliding_window:
             raise ValueError("pipeline-parallel serving v1 does not cover "
                              "sliding-window attention")
+        if len(model.groups) != 1:
+            raise ValueError(
+                "pipeline-parallel serving needs a homogeneous layer "
+                f"stack; {model.md_name if hasattr(model, 'md_name') else ''}"
+                f" has {len(model.groups)} layer groups")
         self.model = model
         self.mesh = mesh
         self.axis = axis
         self.num_stages = mesh.shape[axis]
         self.tp = int(mesh.shape.get("tensor", 1))
+        # EP composes inside each stage exactly like TP: the expert axis
+        # stays on the AUTO side of the partial-manual shard_map, so
+        # GSPMD places each stage's expert stacks over its own devices
+        # (the flat engine's EP, per stage)
+        self.ep = int(mesh.shape.get("expert", 1))
         (self.group,) = model.groups
         if model.arch.num_layers % self.num_stages:
             raise ValueError(f"{model.arch.num_layers} layers do not split "
@@ -79,7 +94,8 @@ class PipelineServeExecutor:
         gname = self.group.name
         return {
             k: (jax.tree.map(lambda _: P(self.axis), v)
-                if k == gname else jax.tree.map(lambda _: P(), v))
+                if k in (gname, "serve_lora")
+                else jax.tree.map(lambda _: P(), v))
             for k, v in staged_params.items()
         }
 
@@ -94,7 +110,7 @@ class PipelineServeExecutor:
         axes = self.model.param_logical_axes()
 
         def leaf(ax, prefix=()):
-            if self.tp <= 1:
+            if self.tp * self.ep <= 1:
                 return NamedSharding(
                     self.mesh, P(*prefix) if prefix else P())
             return NamedSharding(
@@ -115,6 +131,11 @@ class PipelineServeExecutor:
                 out[k] = {name: entry(name, sub, axes[gname],
                                       prefix=(self.axis,))
                           for name, sub in v.items()}
+            elif k == "serve_lora":
+                # adapter factors: stage dim on pipeline, tiny factor
+                # dims replicated (same as the flat engine's P() layout)
+                out[k] = jax.tree.map(
+                    lambda _: NamedSharding(self.mesh, P(self.axis)), v)
             elif k in axes:
                 out[k] = entry(k, v, axes)
             else:
@@ -150,8 +171,11 @@ class PipelineServeExecutor:
     def _local_view(self, params: dict, ck, cv):
         """Inside shard_map: strip the stage dim from this stage's shard."""
         gname = self.group.name
-        stack = jax.tree.map(lambda v: v[0], params[gname])
-        local_params = {**params, gname: stack}
+        local_params = {**params,
+                        gname: jax.tree.map(lambda v: v[0], params[gname])}
+        if "serve_lora" in params:
+            local_params["serve_lora"] = jax.tree.map(
+                lambda v: v[0], params["serve_lora"])
         return local_params, ck[0], cv[0]
 
     # ------------------------------------------------------------------
@@ -166,7 +190,7 @@ class PipelineServeExecutor:
         fwd = [(i, (i + 1) % S) for i in range(S)]
 
         def local_decode(params, ck, cv, tokens, positions, page_tables,
-                         active):
+                         active, adapter_ids):
             p = jax.lax.axis_index(axis)
             local_params, ck_l, cv_l = self._local_view(params, ck, cv)
             B = tokens.shape[0]
@@ -174,6 +198,7 @@ class PipelineServeExecutor:
             pos = positions.reshape(M, mb)
             pts = page_tables.reshape(M, mb, -1)
             act = active.reshape(M, mb)
+            aids = adapter_ids.reshape(M, mb)
             # embed once per microbatch (only stage 0 consumes it; the
             # gather is cheap enough to not gate on p == 0)
             x0_all = model._embed(local_params,
@@ -192,7 +217,7 @@ class PipelineServeExecutor:
                     local_params, cache_l, x_in, "decode",
                     positions=pos[i][:, None], page_tables=pts[i],
                     lengths=pos[i] + 1, true_lens=None,
-                    active=act[i] & valid)
+                    active=act[i] & valid, adapter_ids=aids[i])
                 ck_l, cv_l = cache_l.k, cache_l.v
                 # final-norm + vocab projection only on the last stage's
                 # valid ticks — everywhere else the accumulator stays 0
@@ -220,17 +245,21 @@ class PipelineServeExecutor:
         ax = self.axis
         sharded = None
 
-        def decode(params, cache, tokens, positions, page_tables, active):
+        def decode(params, cache, tokens, positions, page_tables, active,
+                   adapter_ids=None):
             nonlocal sharded
             if sharded is None:
                 specs = self._param_specs(params)
                 sharded = jax.shard_map(
                     local_decode, mesh=self.mesh,
-                    in_specs=(specs, P(ax), P(ax), P(), P(), P(), P()),
+                    in_specs=(specs, P(ax), P(ax), P(), P(), P(), P(), P()),
                     out_specs=(P(ax), P(ax), P()),
                     axis_names={ax}, check_vma=False)
+            if adapter_ids is None:
+                adapter_ids = jnp.zeros(tokens.shape[:1], jnp.int32)
             k, v, logits = sharded(params, cache.k, cache.v, tokens,
-                                   positions, page_tables, active)
+                                   positions, page_tables, active,
+                                   adapter_ids)
             return KVCache(k=k, v=v), logits
 
         return decode
@@ -247,7 +276,7 @@ class PipelineServeExecutor:
         fwd = [(i, (i + 1) % S) for i in range(S)]
 
         def local_prefill(params, ck, cv, tokens, true_lens, page_tables,
-                          start_pos):
+                          start_pos, adapter_ids):
             p = jax.lax.axis_index(axis)
             local_params, ck_l, cv_l = self._local_view(params, ck, cv)
             B, T = tokens.shape
@@ -266,7 +295,8 @@ class PipelineServeExecutor:
                     local_params, cache_l, x_in, "prefill",
                     positions=positions, page_tables=page_tables,
                     lengths=tl, true_lens=tl, active=None,
-                    start_pos=start_pos if with_context else None)
+                    start_pos=start_pos if with_context else None,
+                    adapter_ids=adapter_ids)
                 ck_l, cv_l = cache_l.k, cache_l.v
                 use = valid & (p == S - 1)
 
@@ -296,19 +326,22 @@ class PipelineServeExecutor:
         sharded = None
 
         def prefill(params, cache, tokens, true_lens, page_tables,
-                    start_pos=None):
+                    start_pos=None, adapter_ids=None):
             nonlocal sharded
             if sharded is None:
                 specs = self._param_specs(params)
                 sharded = jax.shard_map(
                     local_prefill, mesh=self.mesh,
-                    in_specs=(specs, P(ax), P(ax), P(), P(), P(), P()),
+                    in_specs=(specs, P(ax), P(ax), P(), P(), P(), P(), P()),
                     out_specs=(P(ax), P(ax), P()),
                     axis_names={ax}, check_vma=False)
             if start_pos is None:
                 start_pos = jnp.zeros((tokens.shape[0],), jnp.int32)
+            if adapter_ids is None:
+                adapter_ids = jnp.zeros((tokens.shape[0],), jnp.int32)
             k, v, logits = sharded(params, cache.k, cache.v, tokens,
-                                   true_lens, page_tables, start_pos)
+                                   true_lens, page_tables, start_pos,
+                                   adapter_ids)
             return KVCache(k=k, v=v), logits
 
         return prefill

@@ -1,0 +1,88 @@
+"""Shared 2-process jax.distributed cluster boot (leader HTTP +
+lockstep worker) used by tests/test_multihost.py and the driver's
+__graft_entry__ pp-over-2-procs dryrun.
+
+Boots via helpers/mh_server.py with the same env contract the rendered
+StatefulSet injects (TPU_WORKER_ID / TPU_WORKER_HOSTNAMES /
+KAITO_COORDINATOR — kaito_tpu/manifests/inference.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+from contextlib import contextmanager
+
+HELPER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "mh_server.py")
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@contextmanager
+def boot_cluster(extra_args, timeout_s: float = 300.0):
+    """Yield the leader's base URL with both processes healthy; raise
+    RuntimeError (with the dead processes' tail) on boot failure."""
+    coord = free_port()
+    http = free_port()
+    args = ["--model", "tiny-llama-test", "--port", str(http),
+            "--max-model-len", "128", "--dtype", "float32"] + extra_args
+    procs = []
+    try:
+        for pid in (1, 0):     # worker first; leader joins
+            env = dict(os.environ)
+            env.update({
+                "TPU_WORKER_ID": str(pid),
+                "TPU_WORKER_HOSTNAMES": "127.0.0.1,127.0.0.1",
+                "KAITO_COORDINATOR": f"127.0.0.1:{coord}",
+                # `python script.py` puts the script dir, not cwd, on
+                # sys.path — the helper must still import kaito_tpu.
+                "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+            })
+            procs.append(subprocess.Popen(
+                [sys.executable, HELPER] + args, env=env, cwd=REPO,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+        base = f"http://127.0.0.1:{http}"
+        deadline = time.monotonic() + timeout_s
+        last = None
+        while time.monotonic() < deadline:
+            if any(p.poll() is not None for p in procs):
+                break
+            try:
+                with urllib.request.urlopen(base + "/health", timeout=2) as r:
+                    if json.loads(r.read()).get("status") == "ok":
+                        break
+            except Exception as e:
+                last = e
+                time.sleep(2)
+        else:
+            raise RuntimeError(f"cluster never became healthy: {last}")
+        if any(p.poll() is not None for p in procs):
+            # terminate survivors first so communicate() cannot block
+            for p in procs:
+                if p.poll() is None:
+                    p.terminate()
+            out = b"\n".join((p.communicate()[0] or b"") for p in procs)
+            raise RuntimeError(f"a process died during startup:\n"
+                               f"{out.decode(errors='replace')[-3000:]}")
+        yield base
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()

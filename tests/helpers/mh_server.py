@@ -9,7 +9,11 @@ local devices per process BEFORE the backend initializes.
 import os
 import sys
 
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=" \
+    + os.environ.get("MH_LOCAL_DEVICES", "2")
+# env var, not just config: server.main()'s apply_platform_env makes
+# JAX_PLATFORMS authoritative, so an inherited =axon would win otherwise
+os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax
 

@@ -176,3 +176,32 @@ def test_per_request_lora_under_tp(tmp_path):
     assert out_base == ref_base
     assert out_a == ref_a
     assert out_a != out_base       # the adapter is a real delta
+
+
+def test_per_request_lora_under_pp(tmp_path):
+    """Round-3 known-gap #3 closed: per-request adapter stacks ride the
+    stage-split layer stacks under pipeline parallelism (no
+    merge-into-base), with single-device parity for base AND adapter
+    traffic on the same engine."""
+    _make_adapter(tmp_path / "style-a", seed=1)
+    cfg = dict(BASE, max_num_seqs=4, adapters_dir=str(tmp_path))
+    ref_eng = InferenceEngine(EngineConfig(**cfg))
+    pp_eng = InferenceEngine(EngineConfig(**cfg, pipeline_parallel=2,
+                                          pp_microbatches=2))
+    assert not pp_eng.adapters_merged
+    assert pp_eng.adapter_index == {"style-a": 1}
+    ref_eng.start(); pp_eng.start()
+    try:
+        ref_base = list(ref_eng.submit([5, 6, 7], _greedy(6)).stream())
+        ref_a = list(ref_eng.submit([5, 6, 7], _greedy(6),
+                                    adapter="style-a").stream())
+        # concurrent mixed traffic: base and adapter share the
+        # microbatched decode window
+        reqs = [pp_eng.submit([5, 6, 7], _greedy(6)),
+                pp_eng.submit([5, 6, 7], _greedy(6), adapter="style-a")]
+        out_base, out_a = [list(r.stream()) for r in reqs]
+    finally:
+        ref_eng.stop(); pp_eng.stop()
+    assert out_base == ref_base
+    assert out_a == ref_a
+    assert out_a != out_base

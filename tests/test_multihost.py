@@ -9,23 +9,9 @@ command, pkg/model/interface.go:534-560).
 """
 
 import json
-import os
-import socket
-import subprocess
-import sys
-import time
 import urllib.request
 
 import pytest
-
-HELPER = os.path.join(os.path.dirname(__file__), "helpers", "mh_server.py")
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
 
 
 def _post(url: str, body: dict, timeout: float = 240.0) -> dict:
@@ -40,60 +26,29 @@ def _post(url: str, body: dict, timeout: float = 240.0) -> dict:
         return json.loads(r.read())
 
 
+def _boot_cluster(extra_args):
+    from tests.helpers.mh_cluster import boot_cluster
+
+    try:
+        with boot_cluster(extra_args) as base:
+            yield base
+    except RuntimeError as e:
+        pytest.fail(str(e))
+
+
 @pytest.fixture(scope="module")
 def cluster():
-    coord = _free_port()
-    http = _free_port()
-    args = ["--model", "tiny-llama-test", "--port", str(http),
-            "--max-model-len", "128", "--dtype", "float32",
-            "--tensor-parallel-size", "4"]
-    procs = []
-    try:
-        for pid in (1, 0):     # worker first; leader joins
-            env = dict(os.environ)
-            env.update({
-                "TPU_WORKER_ID": str(pid),
-                "TPU_WORKER_HOSTNAMES": "127.0.0.1,127.0.0.1",
-                "KAITO_COORDINATOR": f"127.0.0.1:{coord}",
-                # `python script.py` puts the script dir, not cwd, on
-                # sys.path — the helper must still import kaito_tpu.
-                "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
-            })
-            procs.append(subprocess.Popen(
-                [sys.executable, HELPER] + args, env=env, cwd=REPO,
-                stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
-        base = f"http://127.0.0.1:{http}"
-        deadline = time.monotonic() + 300
-        last = None
-        while time.monotonic() < deadline:
-            if any(p.poll() is not None for p in procs):
-                break
-            try:
-                with urllib.request.urlopen(base + "/health", timeout=2) as r:
-                    if json.loads(r.read()).get("status") == "ok":
-                        break
-            except Exception as e:
-                last = e
-                time.sleep(2)
-        else:
-            pytest.fail(f"cluster never became healthy: {last}")
-        if any(p.poll() is not None for p in procs):
-            # terminate survivors first so communicate() cannot block
-            for p in procs:
-                if p.poll() is None:
-                    p.terminate()
-            out = b"\n".join((p.communicate()[0] or b"") for p in procs)
-            pytest.fail(f"a process died during startup:\n"
-                        f"{out.decode(errors='replace')[-3000:]}")
-        yield base
-    finally:
-        for p in procs:
-            p.terminate()
-        for p in procs:
-            try:
-                p.wait(timeout=15)
-            except subprocess.TimeoutExpired:
-                p.kill()
+    yield from _boot_cluster(["--tensor-parallel-size", "4"])
+
+
+@pytest.fixture(scope="module")
+def cluster_pp():
+    """The north-star tier-3 serving shape over REAL process
+    boundaries: pipeline across the 2 processes (the DCN tier), TP
+    inside each process's 2 local devices (reference:
+    interface.go:514-560, multi-node PP tier)."""
+    yield from _boot_cluster(["--pipeline-parallel-size", "2",
+                              "--tensor-parallel-size", "2"])
 
 
 def test_multihost_serves_completions(cluster):
@@ -116,6 +71,31 @@ def test_multihost_concurrent_requests(cluster):
 
     with cf.ThreadPoolExecutor(4) as ex:
         outs = list(ex.map(one, range(4)))
+    assert all(o["usage"]["completion_tokens"] == 6 for o in outs)
+
+
+def test_multihost_pp_serves_completions(cluster_pp):
+    """PP over 2 processes: stages live in different OS processes and
+    activations cross the process boundary via the jitted ppermute
+    ring; greedy decode must be deterministic across the lockstep."""
+    body = {"model": "tiny-llama-test", "prompt": "pp across processes",
+            "max_tokens": 8, "temperature": 0}
+    out = _post(cluster_pp + "/v1/completions", body)
+    assert out["usage"]["completion_tokens"] == 8
+    out2 = _post(cluster_pp + "/v1/completions", body)
+    assert out2["choices"][0]["text"] == out["choices"][0]["text"]
+
+
+def test_multihost_pp_concurrent_requests(cluster_pp):
+    import concurrent.futures as cf
+
+    def one(i):
+        return _post(cluster_pp + "/v1/completions", {
+            "model": "tiny-llama-test", "prompt": f"pp req {i}",
+            "max_tokens": 6, "temperature": 0})
+
+    with cf.ThreadPoolExecutor(3) as ex:
+        outs = list(ex.map(one, range(3)))
     assert all(o["usage"]["completion_tokens"] == 6 for o in outs)
 
 

@@ -108,6 +108,29 @@ def test_moe_tp2_ep2_parity(moe_md):
     assert both == ref
 
 
+def test_moe_pp2_ep2_parity(moe_md):
+    """MoE under the tier-3 PP shape: pipeline stages with the expert
+    axis riding the auto side of the partial-manual shard_map — the
+    DeepSeek-V3-class composition (PP over DCN, EP inside each stage)
+    that round-3 left unsupported."""
+    ref = _outputs(EngineConfig(model="tiny-moe-par", **BASE), moe_md, PROMPTS)
+    pp = _outputs(EngineConfig(model="tiny-moe-par", **BASE,
+                               pipeline_parallel=2, expert_parallel=2,
+                               pp_microbatches=2), moe_md, PROMPTS)
+    assert pp == ref
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs >=8 devices")
+def test_moe_pp2_ep2_tp2_parity(moe_md):
+    """Full composition: pp=2 x ep=2 x tp=2 over 8 virtual devices."""
+    ref = _outputs(EngineConfig(model="tiny-moe-par", **BASE), moe_md, PROMPTS)
+    full = _outputs(EngineConfig(model="tiny-moe-par", **BASE,
+                                 pipeline_parallel=2, expert_parallel=2,
+                                 tensor_parallel=2, pp_microbatches=2),
+                    moe_md, PROMPTS)
+    assert full == ref
+
+
 def test_mla_tp2_parity(mla_md):
     ref = _outputs(EngineConfig(model="tiny-mla-par", **BASE), mla_md, PROMPTS)
     tp = _outputs(EngineConfig(model="tiny-mla-par", **BASE,
