@@ -246,9 +246,14 @@ class InferenceEngine:
                     sum(x.nbytes for x in jax.tree.leaves(self.params))
                     / 2**30)
 
+        self.sizing_report: dict = {}
         num_pages = cfg.max_pages or self._derive_max_pages()
         num_pages = max(num_pages, cfg.max_num_seqs * self.pages_per_seq // 4 + 2)
         self._num_pages = num_pages
+        if cfg.max_pages:
+            self.sizing_report = {"source": "configured"}
+        # report the FINAL pool size (post-floor), not the derived value
+        self.sizing_report["pages"] = num_pages
         self.cache = self._fresh_cache()
         logger.info("KV cache: %d pages x %d tokens (%.2f GiB)",
                     num_pages, cfg.page_size,
@@ -393,10 +398,7 @@ class InferenceEngine:
         over the expert axis; XLA inserts the collectives."""
         tp = self.cfg.tensor_parallel
         ep = self.cfg.expert_parallel
-        if ep > 1 and (self.md.arch.num_experts < ep
-                       or self.md.arch.num_experts % ep):
-            raise ValueError(f"expert_parallel={ep} must divide the "
-                             f"{self.md.arch.num_experts} experts")
+        self._validate_ep(ep)
         if tp * ep <= 1:
             return None
         from kaito_tpu.parallel.mesh import build_mesh
@@ -408,6 +410,12 @@ class InferenceEngine:
                              f"but only {len(devices)} devices visible")
         return build_mesh(make_mesh_spec(expert=ep, tensor=tp),
                           devices[:tp * ep])
+
+    def _validate_ep(self, ep: int) -> None:
+        if ep > 1 and (self.md.arch.num_experts < ep
+                       or self.md.arch.num_experts % ep):
+            raise ValueError(f"expert_parallel={ep} must divide the "
+                             f"{self.md.arch.num_experts} experts")
 
     def _build_pp_executor(self):
         """Stage-sharded serving executor over the planner's pipeline
@@ -421,10 +429,7 @@ class InferenceEngine:
         pp = self.cfg.pipeline_parallel
         tp = max(1, self.cfg.tensor_parallel)
         ep = max(1, self.cfg.expert_parallel)
-        if ep > 1 and (self.md.arch.num_experts < ep
-                       or self.md.arch.num_experts % ep):
-            raise ValueError(f"expert_parallel={ep} must divide the "
-                             f"{self.md.arch.num_experts} experts")
+        self._validate_ep(ep)
         devices = jax.devices()
         if len(devices) < pp * ep * tp:
             raise ValueError(f"pipeline_parallel={pp} x expert_parallel={ep}"
@@ -608,7 +613,8 @@ class InferenceEngine:
                 init_q, out_shardings=self._quantized_param_shardings())(
                     jax.random.PRNGKey(self.cfg.seed))
         else:
-            with jax.default_device(jax.devices()[0]):
+            # local_devices, not devices: see _init_params
+            with jax.default_device(jax.local_devices()[0]):
                 params = jax.jit(init_q)(jax.random.PRNGKey(self.cfg.seed))
         jax.block_until_ready(params)
         logger.info("int8 weights ready in %.1fs (%.2f GiB)",
@@ -637,15 +643,46 @@ class InferenceEngine:
         # sizing runs AFTER params are resident (and quantized), so the
         # ACTUAL weight bytes are known — no dtype/quant estimation
         weights = sum(x.nbytes for x in jax.tree.leaves(self.params))
+        # static estimator's view of this chip, for the disagreement log
+        est_overhead = PER_CHIP_OVERHEAD_BYTES
         try:
             stats = dev.memory_stats()
             limit = stats["bytes_limit"] * HBM_UTILIZATION
+            in_use = stats["bytes_in_use"]
+            # SELF-MEASURED program temps (SURVEY §7 hard-part (d), the
+            # profile_run analogue): run the widest sampler program —
+            # the fused-decode step's biggest scratch, the [B, V] top-p
+            # sort — and take the observed peak delta when it exceeds
+            # the static overhead constant
+            temps = self._measure_sampler_temps(dev)
+            overhead = max(est_overhead, temps)
             # bytes_in_use already includes the resident weights
-            free = limit - stats["bytes_in_use"] - PER_CHIP_OVERHEAD_BYTES
+            free = limit - in_use - overhead
+            self.sizing_report = {
+                "hbm_limit_bytes": int(stats["bytes_limit"]),
+                "weights_bytes": int(weights),
+                "measured_in_use_bytes": int(in_use),
+                "measured_temps_bytes": int(temps),
+                "estimator_overhead_bytes": int(est_overhead),
+                "source": "measured",
+            }
+            # disagreement between the static estimator model and the
+            # device's own accounting (fed to status.performance via the
+            # benchmark probe / health surface)
+            drift = in_use - weights
+            if abs(drift) > est_overhead:
+                logger.warning(
+                    "HBM estimator drift: device reports %.2f GiB in use "
+                    "vs %.2f GiB weights (drift %.2f GiB > static "
+                    "overhead %.2f GiB); sizing from measurement",
+                    in_use / 2**30, weights / 2**30, drift / 2**30,
+                    est_overhead / 2**30)
         except Exception:
             if dev.platform == "cpu":
                 # host RAM: enough for max_num_seqs full contexts
-                return self.cfg.max_num_seqs * self.pages_per_seq + 1
+                pages = self.cfg.max_num_seqs * self.pages_per_seq + 1
+                self.sizing_report = {"source": "seq-cap", "pages": pages}
+                return pages
             # TPU backends that don't expose memory_stats (seen on the
             # axon remote plugin): budget against a known per-chip HBM
             # size instead of assuming unlimited — sizing for the seq
@@ -653,9 +690,46 @@ class InferenceEngine:
             limit = float(os.environ.get(
                 "KAITO_HBM_BYTES", 16 * 1024 ** 3)) * HBM_UTILIZATION
             free = limit - weights - PER_CHIP_OVERHEAD_BYTES
+            self.sizing_report = {
+                "hbm_limit_bytes": int(limit / HBM_UTILIZATION),
+                "weights_bytes": int(weights),
+                "estimator_overhead_bytes": int(est_overhead),
+                "source": "static",
+            }
         pages = int(max(free, 0) // (bpt * self.cfg.page_size))
         cap = self.cfg.max_num_seqs * self.pages_per_seq
         return max(2, min(pages, cap) + 1)
+
+    def _measure_sampler_temps(self, dev) -> int:
+        """Compile + run the [max_num_seqs, vocab] sampler with the
+        sort path live (one top-p row) and return the peak-memory delta
+        it caused — the dominant decode-program scratch at 100k+
+        vocabs.  Returns 0 when the backend can't report peaks."""
+        try:
+            base_peak = dev.memory_stats().get("peak_bytes_in_use", 0)
+            if not base_peak:
+                return 0
+            from kaito_tpu.engine.sampler import SamplingState, sample
+
+            B, V = self.cfg.max_num_seqs, self.md.arch.vocab_size
+            # pin to THIS engine's device: under in-engine DP the
+            # default device is another group's chip, which would both
+            # measure nothing and transiently tax a foreign HBM budget
+            with jax.default_device(dev):
+                state = SamplingState.create(B, self.cfg.seed)
+                state = state.set_slot(0, temperature=1.0, top_k=0,
+                                       top_p=0.9, seed=1)
+                logits = jnp.zeros((B, V), jnp.float32)
+                toks, _ = jax.jit(sample)(logits, state)
+                jax.block_until_ready(toks)
+            peak = dev.memory_stats().get("peak_bytes_in_use", 0)
+            # peak is a lifetime high-water mark: if weight loading
+            # already peaked higher, the delta reads 0 and sizing falls
+            # back to the static overhead constant (safe direction)
+            return int(max(0, peak - base_peak))
+        except Exception:
+            logger.debug("sampler temp probe failed", exc_info=True)
+            return 0
 
     # ------------------------------------------------------------------
     # Compiled steps

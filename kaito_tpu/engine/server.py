@@ -116,7 +116,13 @@ class OpenAIHandler(BaseHTTPRequestHandler):
     def do_GET(self):
         st = self.state
         if self.path == "/health":
-            self._json(200, {"status": "ok"})
+            body = {"status": "ok"}
+            sizing = getattr(st.engine, "sizing_report", None)
+            if sizing:
+                # self-measured HBM sizing + estimator drift: the
+                # benchmark probe folds this into status.performance
+                body["hbm_sizing"] = sizing
+            self._json(200, body)
         elif self.path == "/metrics":
             body = st.metrics.registry.expose().encode()
             self.send_response(200)

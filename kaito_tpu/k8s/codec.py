@@ -59,8 +59,11 @@ STATUS_SUBRESOURCE = set(TYPED_KINDS)
 
 
 def camel(s: str) -> str:
+    # "id" follows the Go/k8s acronym convention on the wire
+    # (reference: ragengine_types.go json:"modelID")
     parts = s.split("_")
-    return parts[0] + "".join(p.title() for p in parts[1:])
+    return parts[0] + "".join(
+        "ID" if p == "id" else p.title() for p in parts[1:])
 
 
 def _enc(v: Any) -> Any:

@@ -146,6 +146,14 @@ def run_benchmark(base: str, *, duration_s: float = BENCHMARK_DURATION_S,
         "errors": errors[0],
         "max_concurrency": concurrency,
     }
+    try:
+        health = json.loads(_get(base + "/health"))
+        if isinstance(health, dict) and health.get("hbm_sizing"):
+            # engine's self-measured HBM sizing + estimator drift rides
+            # into status.performance alongside the throughput numbers
+            result["hbm_sizing"] = health["hbm_sizing"]
+    except Exception:
+        pass
     _emit("KAITO_BENCHMARK_RESULT", result, sink)
     return result
 

@@ -1,0 +1,65 @@
+"""Precision/recall floors for the heuristic guardrail scanners
+(VERDICT r3 weak #5): the model-free gibberish/code/PII/secrets
+analogues must hold a measured detection floor on a labeled corpus —
+like the reference's llm-guard golden testdata, a regression here fails
+the build instead of silently degrading detection quality.
+
+Floors are set just below the currently measured rates (printed by the
+test on failure); tighten them when the scanners improve, never loosen
+them without changing the corpus.
+"""
+
+import json
+import os
+
+import pytest
+
+from kaito_tpu.rag.guardrails import (
+    CodeScanner,
+    GibberishScanner,
+    PIIScanner,
+    SecretsScanner,
+)
+
+CORPUS = json.load(open(os.path.join(os.path.dirname(__file__), "testdata",
+                                     "guardrails_corpus.json")))
+
+# (scanner factory, corpus key, precision floor, recall floor)
+CASES = [
+    (lambda: GibberishScanner(), "gibberish", 1.0, 0.85),
+    (lambda: CodeScanner(mode="block"), "code", 1.0, 1.0),
+    (lambda: PIIScanner(), "pii", 1.0, 1.0),
+    (lambda: SecretsScanner(), "secrets", 1.0, 1.0),
+]
+
+
+def _rates(scanner, key):
+    pos = CORPUS[key]["positive"]
+    neg = CORPUS[key]["negative"]
+    tp = sum(1 for t in pos if not scanner.scan(t).valid)
+    fp = sum(1 for t in neg if not scanner.scan(t).valid)
+    fn = len(pos) - tp
+    precision = tp / (tp + fp) if tp + fp else 1.0
+    recall = tp / (tp + fn) if tp + fn else 1.0
+    return precision, recall, tp, fp, fn
+
+
+@pytest.mark.parametrize("factory,key,p_floor,r_floor",
+                         CASES, ids=[c[1] for c in CASES])
+def test_scanner_quality_floor(factory, key, p_floor, r_floor):
+    scanner = factory()
+    precision, recall, tp, fp, fn = _rates(scanner, key)
+    detail = (f"{key}: precision={precision:.2f} recall={recall:.2f} "
+              f"(tp={tp} fp={fp} fn={fn}; floors p>={p_floor} r>={r_floor})")
+    assert precision >= p_floor, detail
+    assert recall >= r_floor, detail
+
+
+def test_corpus_is_balanced():
+    """Each scanner's corpus keeps enough mass on both sides that the
+    floors mean something."""
+    for key, sets in CORPUS.items():
+        if key.startswith("_"):
+            continue
+        assert len(sets["positive"]) >= 4, key
+        assert len(sets["negative"]) >= 4, key

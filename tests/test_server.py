@@ -43,7 +43,15 @@ def _get(url, path):
 
 def test_health_and_models(served):
     url, _ = served
-    assert json.loads(_get(url, "/health").read())["status"] == "ok"
+    health = json.loads(_get(url, "/health").read())
+    assert health["status"] == "ok"
+    # the engine always publishes its HBM sizing decision (source is
+    # "measured" when the backend reports memory stats, else "static";
+    # CPU test engines size from the seq cap and may omit it)
+    sizing = health.get("hbm_sizing")
+    if sizing:
+        assert sizing["source"] in ("measured", "static", "seq-cap")
+        assert sizing["pages"] >= 2
     models = json.loads(_get(url, "/v1/models").read())
     assert models["data"][0]["id"] == "tiny"
 
