@@ -1,0 +1,76 @@
+"""Generate golden logprobs/continuations for the committed
+tiny-llama-real checkpoint.
+
+Boots the REAL serving engine from checkpoints/tiny-llama-real (the
+same weights_dir path production uses), scores fixed prompts through
+the completions echo+logprobs surface, and records greedy
+continuations — bf16-load, rope, scoring, and sampling correctness all
+pin to these numbers (tests/test_real_checkpoint.py).
+
+Run after (re)training: python hack/gen_goldens.py
+"""
+
+import json
+import os
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CKPT = os.path.join(REPO, "checkpoints", "tiny-llama-real")
+OUT = os.path.join(REPO, "tests", "testdata", "tiny_real_goldens.json")
+
+PROMPTS = [
+    "This package provides a",
+    "License: Apache License\n",
+    "The documentation for this module includes",
+]
+
+
+def main():
+    from kaito_tpu.engine.config import EngineConfig
+    from kaito_tpu.engine.engine import InferenceEngine, SamplingParams
+
+    golden = {"checkpoint": "checkpoints/tiny-llama-real",
+              "report": json.load(open(os.path.join(
+                  CKPT, "training_report.json"))),
+              "prompts": []}
+    for quant in ("", "int8"):
+        cfg = EngineConfig(model="tiny-llama-real", weights_dir=CKPT,
+                           dtype="float32", kv_dtype="float32",
+                           max_model_len=512, max_num_seqs=2,
+                           prefill_buckets=(64, 128),
+                           enable_prefix_caching=False,
+                           quantization=quant, seed=0)
+        eng = InferenceEngine(cfg)
+        eng.start()
+        try:
+            for text in PROMPTS:
+                toks = eng.tokenizer.encode(text)
+                req = eng.submit(toks, SamplingParams(
+                    max_tokens=12, temperature=0.0, ignore_eos=True,
+                    logprobs=True))
+                out = list(req.stream())
+                entry = next((p for p in golden["prompts"]
+                              if p["text"] == text), None)
+                if entry is None:
+                    entry = {"text": text, "prompt_tokens": toks}
+                    golden["prompts"].append(entry)
+                key = "int8" if quant else "fp32"
+                entry[key] = {
+                    "greedy_tokens": out,
+                    "logprobs": [round(float(x), 5)
+                                 for x in req.output_logprobs],
+                }
+        finally:
+            eng.stop()
+    with open(OUT, "w") as f:
+        json.dump(golden, f, indent=1)
+    print("wrote", OUT)
+    for p in golden["prompts"]:
+        print(f"  {p['text']!r}: fp32 {p['fp32']['greedy_tokens'][:6]}...")
+
+
+if __name__ == "__main__":
+    main()

@@ -18,7 +18,17 @@ kubectl config use-context "kind-$CLUSTER"
 kubectl apply -f config/crd/
 kubectl create namespace kaito-system --dry-run=client -o yaml | kubectl apply -f -
 
-echo "starting manager against kind-$CLUSTER (ctrl-c to stop)"
-exec python -m kaito_tpu.controllers.manager \
-    --kubeconfig "$HOME/.kube/config" \
+# KubeClient speaks bearer-token/plain HTTP, not kubeconfig client
+# certs: bridge through kubectl proxy (same wire paths, no TLS dance)
+PROXY_PORT=${PROXY_PORT:-8001}
+kubectl proxy --port="$PROXY_PORT" &
+PROXY_PID=$!
+trap 'kill $PROXY_PID' EXIT
+sleep 1
+
+echo "starting manager against kind-$CLUSTER via kubectl proxy (ctrl-c to stop)"
+# no exec: the shell must survive the manager so the EXIT trap can
+# reap the proxy (exec would orphan it and pin the port)
+python -m kaito_tpu.controllers.manager \
+    --kube-api-url "http://127.0.0.1:$PROXY_PORT" \
     --namespace kaito-system "$@"

@@ -456,14 +456,18 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                         return self._error(
                             400, "tool calls are not supported with "
                                  "streaming yet")
-                    # advertise tools hermes-style; parse_message reads
-                    # the call format back out of the generation. Merge
-                    # into an existing system message so chat templates
-                    # that keep only one system block see both.
+                    # advertise tools in the model's own call wire
+                    # format (the preset's tool_call_parser mode);
+                    # parse_message reads it back out. Merge into an
+                    # existing system message so chat templates that
+                    # keep only one system block see both.
                     from kaito_tpu.engine.parsers import render_tools_prompt
 
                     messages = list(messages)
-                    tp = render_tools_prompt(tools)
+                    tp = render_tools_prompt(
+                        tools, mode=getattr(st.engine.md,
+                                            "tool_call_parser", "")
+                        or "hermes")
                     if messages and messages[0].get("role") == "system":
                         messages[0] = {
                             "role": "system",
@@ -710,7 +714,8 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                     text,
                     reasoning=bool(getattr(st.engine.md,
                                            "reasoning_parser", None)),
-                    tools=bool(body.get("tools")))
+                    tools=bool(body.get("tools")),
+                    tool_mode=getattr(st.engine.md, "tool_call_parser", ""))
                 message = {"role": "assistant", "content": parsed.content}
                 if parsed.reasoning_content is not None:
                     message["reasoning_content"] = parsed.reasoning_content

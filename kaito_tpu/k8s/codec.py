@@ -106,11 +106,20 @@ def _dec_value(tp: Any, w: Any) -> Any:
     return w
 
 
+def _legacy_camel(s: str) -> str:
+    """Pre-acronym spelling (``modelId``): read-compat for CRs
+    persisted by builds before camel() learned the ID convention."""
+    parts = s.split("_")
+    return parts[0] + "".join(p.title() for p in parts[1:])
+
+
 def _dec_dataclass(cls: type, d: dict) -> Any:
     hints = typing.get_type_hints(cls)
     kwargs = {}
     for f in dataclasses.fields(cls):
         w = d.get(camel(f.name), _MISSING)
+        if w is _MISSING:
+            w = d.get(_legacy_camel(f.name), _MISSING)
         if w is _MISSING:
             continue
         kwargs[f.name] = _dec_value(hints[f.name], w)

@@ -29,10 +29,12 @@ _SLOW_MODULES = {
     "test_chunked_prefill", "test_decode_run_ahead", "test_dp_serve",
     "test_e2e_sim", "test_engine_core", "test_engine_model",
     "test_engine_tp", "test_engine_tp_features", "test_flash_prefill",
-    "test_host_offload", "test_mla", "test_moe_ragged", "test_multihost",
+    "test_host_offload", "test_kind_e2e", "test_mla", "test_moe_ragged",
+    "test_multihost",
     "test_pallas_model_path", "test_pallas_ops", "test_parallel_families",
     "test_pd_disaggregation", "test_pipeline_parallel", "test_pp_serve",
-    "test_prefix_caching", "test_quant", "test_ring_attention",
+    "test_prefix_caching", "test_quant", "test_real_checkpoint",
+    "test_ring_attention",
     "test_scheduler", "test_serve_with_adapter", "test_server",
     "test_streaming", "test_train_step", "test_trainer_mesh",
     "test_tuning", "test_weights", "test_parsers",

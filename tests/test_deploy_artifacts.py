@@ -23,8 +23,6 @@ CHARTS = (f"{REPO}/charts/kaito-tpu", f"{REPO}/charts/demo-ui")
 # Helm charts
 # ---------------------------------------------------------------------------
 
-_CTRL = re.compile(r"^\s*\{\{-?\s*(if|else|end|range|with|define|template)"
-                   r"(\s|[^}]*)?\}\}\s*$")
 _EXPR = re.compile(r"\{\{[^}]*\}\}")
 _VALUE_PATH = re.compile(r"\.Values\.([A-Za-z0-9_.]+)")
 

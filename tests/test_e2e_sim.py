@@ -9,6 +9,7 @@ the controller contract specifies.
 """
 
 import json
+import os
 import threading
 import urllib.request
 
@@ -100,3 +101,174 @@ def test_workspace_to_tokens(tmp_path):
     finally:
         server.shutdown()
         engine.stop()
+
+def test_tuning_workspace_to_adapter(tmp_path):
+    """Tuning e2e-sim (VERDICT r3 weak #4): a tuning Workspace renders
+    a Job whose command is actually EXECUTED (the real trainer CLI on a
+    tiny dataset); the produced adapter + completion sentinel are the
+    artifacts the ORAS pusher sidecar ships, and Job success flows back
+    into WorkspaceSucceeded."""
+    from kaito_tpu.api.workspace import TuningInput, TuningOutput, TuningSpec
+    from kaito_tpu.manifests.tuning_job import SENTINEL
+    from kaito_tpu.tuning.cli import main as tuning_main
+    from kaito_tpu.tuning.lora import load_adapter
+
+    mgr = Manager()
+    cloud = FakeCloud(mgr.store)
+    ws = Workspace(
+        ObjectMeta(name="tune"),
+        resource=ResourceSpec(instance_type="ct5lp-hightpu-1t"),
+        tuning=TuningSpec(preset="tiny-llama-test", method="lora",
+                          input=TuningInput(image="data-image:1"),
+                          output=TuningOutput(image="reg.local/adapter:1")))
+    mgr.store.create(ws)
+    for _ in range(6):     # provision -> nodes ready -> job rendered
+        mgr.resync()
+        cloud.tick()
+
+    job = mgr.store.get("Job", "default", "tune")
+    cmd = job.spec["template"]["spec"]["containers"][0]["command"]
+    assert cmd[:4] == ["python", "-m", "kaito_tpu.tuning.cli", "--model"]
+
+    # "kubelet": run the rendered command with the Job's volume mounts
+    # simulated by tmp dirs and a CI-sized step budget
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    rows = [{"instruction": f"add {i} and {i + 1}", "response": str(2 * i + 1)}
+            for i in range(16)]
+    (data_dir / "train.jsonl").write_text(
+        "\n".join(json.dumps(r) for r in rows))
+    out_dir = tmp_path / "results"
+    args = list(cmd[3:])
+    args[args.index("--data-dir") + 1] = str(data_dir)
+    args[args.index("--output-dir") + 1] = str(out_dir)
+    args += ["--max-steps", "3", "--batch-size", "2", "--max-seq-len", "32",
+             "--num-epochs", "1"]
+    tuning_main(args)
+
+    assert os.path.exists(out_dir / SENTINEL)
+    adapter, lcfg, base = load_adapter(str(out_dir / "adapter"))
+    assert base == "tiny-llama-test"
+    assert any("lora_b" in k for k in adapter)
+
+    # job completion (FakeCloud's kubelet sim) -> workspace condition
+    for _ in range(3):
+        cloud.tick()
+        mgr.resync()
+    live = mgr.store.get("Workspace", "default", "tune")
+    assert condition_true(live.status.conditions, COND_WORKSPACE_SUCCEEDED)
+
+
+def test_pd_mri_to_tokens():
+    """P/D e2e-sim: a MultiRoleInference CR renders prefill/decode role
+    workloads whose PD env is then BOOTED as two live engine servers;
+    a forced chunked KV transfer between them matches the monolithic
+    greedy output."""
+    from kaito_tpu.api import MultiRoleInference
+    from kaito_tpu.api.multiroleinference import (
+        MRIModelSpec,
+        MultiRoleInferenceSpec,
+        RoleSpec,
+    )
+
+    mgr = Manager(feature_gates="enableMultiRoleInferenceController=true,"
+                                "gatewayAPIInferenceExtension=true")
+    cloud = FakeCloud(mgr.store)
+    mri = MultiRoleInference(
+        ObjectMeta(name="sim"),
+        MultiRoleInferenceSpec(
+            model=MRIModelSpec(name="tiny-llama-test"),
+            roles=[RoleSpec(type="prefill", replicas=1,
+                            instance_type="ct5lp-hightpu-1t"),
+                   RoleSpec(type="decode", replicas=1,
+                            instance_type="ct5lp-hightpu-1t")]))
+    mgr.store.create(mri)
+    for _ in range(12):
+        mgr.resync()
+        cloud.tick()
+
+    # the rendered role workloads carry the PD side-channel env
+    stss = [s for s in mgr.store.list("StatefulSet")
+            if s.metadata.name.startswith("sim-")]
+    assert len(stss) >= 2, [s.metadata.name for s in stss]
+    env_by_role = {}
+    for s in stss:
+        env = {e["name"]: e.get("value", "") for e in
+               s.spec["template"]["spec"]["containers"][0].get("env", [])}
+        role = "prefill" if "prefill" in s.metadata.name else "decode"
+        env_by_role[role] = env
+    for role, env in env_by_role.items():
+        assert env.get("KAITO_PD_ENABLED") == "true", (role, env)
+        assert env.get("KAITO_PD_ALLOWLIST", "").startswith("http://sim-")
+
+    # "kubelet": boot both roles with that env contract
+    def boot(pd_allow):
+        cfg = EngineConfig(model="tiny-llama-test", max_model_len=256,
+                           page_size=16, max_num_seqs=2, dtype="float32",
+                           kv_dtype="float32", prefill_buckets=(64, 128),
+                           seed=0, pd_enabled=True,
+                           pd_source_allowlist=pd_allow)
+        eng = InferenceEngine(cfg)
+        eng.start()
+        srv = make_server(eng, cfg, host="127.0.0.1", port=0)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return eng, srv, f"http://127.0.0.1:{srv.server_address[1]}"
+
+    # allowlist: the decode pod only accepts KV from its own MRI's
+    # prefill peers; the sim substitutes loopback for cluster DNS
+    pre_eng, pre_srv, pre_url = boot("")
+    dec_eng, dec_srv, dec_url = boot("http://127.0.0.1:")
+    try:
+        def post(url, path, body):
+            req = urllib.request.Request(
+                url + path, data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            return json.loads(urllib.request.urlopen(req, timeout=120).read())
+
+        prompt = "multi role inference"
+        mono = post(dec_url, "/v1/completions", {
+            "prompt": prompt, "max_tokens": 6, "temperature": 0.0})
+        pre = post(pre_url, "/pd/prefill", {"prompt": prompt,
+                                            "temperature": 0.0})
+        out = post(dec_url, "/v1/completions", {
+            "prompt": prompt, "max_tokens": 6, "temperature": 0.0,
+            "kv_transfer": {"source_url": pre_url, "req_id": pre["req_id"],
+                            "prompt_tokens": pre["prompt_tokens"],
+                            "first_token": pre["first_token"],
+                            "force": True}})
+        assert out["choices"][0]["text"] == mono["choices"][0]["text"]
+    finally:
+        pre_srv.shutdown()
+        dec_srv.shutdown()
+        pre_eng.stop()
+        dec_eng.stop()
+
+
+def test_provision_failure_then_recovery():
+    """Failure-path e2e-sim: the cloud never brings the pool up ->
+    InferenceReady stays false with a reason; healing the fault lets
+    the same Workspace converge to ready without re-creation."""
+    mgr = Manager()
+    cloud = FakeCloud(mgr.store)
+    ws = Workspace(
+        ObjectMeta(name="flaky"),
+        resource=ResourceSpec(instance_type="ct5lp-hightpu-1t"),
+        inference=InferenceSpec(preset="tiny-llama-test"))
+    mgr.store.create(ws)
+    mgr.resync()
+    pools = [p.metadata.name for p in mgr.store.list("NodePool")]
+    assert pools, "no NodePool provisioned"
+    cloud.fail_pools.add(pools[0])
+    for _ in range(6):
+        mgr.resync()
+        cloud.tick()
+    live = mgr.store.get("Workspace", "default", "flaky")
+    assert not condition_true(live.status.conditions, COND_INFERENCE_READY)
+
+    # heal the cloud; the controller must converge with no operator help
+    cloud.fail_pools.clear()
+    for _ in range(8):
+        mgr.resync()
+        cloud.tick()
+    live = mgr.store.get("Workspace", "default", "flaky")
+    assert condition_true(live.status.conditions, COND_INFERENCE_READY)

@@ -3,6 +3,8 @@ the reference emits as vLLM flags, generator.go)."""
 
 import json
 
+import pytest
+
 from kaito_tpu.engine.parsers import (
     parse_hermes_tool_calls,
     parse_message,
@@ -63,6 +65,69 @@ def test_tools_prompt_round_trips_format():
         "name": "get_weather", "description": "d",
         "parameters": {"type": "object"}}}])
     assert "get_weather" in prompt and "<tool_call>" in prompt
+
+
+# per-family round trips: render the tools prompt in the preset's wire
+# format, synthesize a completion in that same format, parse it back
+# (reference: tool-chat-{llama3.1-json,mistral,deepseekv3,phi4-mini,
+# hermes}.jinja)
+_FAMILY_CASES = {
+    "hermes": ('<tool_call>{"name": "get_weather", '
+               '"arguments": {"city": "Paris"}}</tool_call>',
+               "<tool_call>"),
+    "mistral": ('[TOOL_CALLS][{"name": "get_weather", '
+                '"arguments": {"city": "Paris"}}]',
+                "[AVAILABLE_TOOLS]"),
+    "llama3_json": ('{"name": "get_weather", '
+                    '"parameters": {"city": "Paris"}}',
+                    '{"name": function name'),
+    "deepseek_v3": ("<｜tool▁calls▁begin｜><｜tool▁call▁begin｜>function"
+                    "<｜tool▁sep｜>get_weather\n```json\n"
+                    '{"city": "Paris"}\n```<｜tool▁call▁end｜>'
+                    "<｜tool▁calls▁end｜><｜end▁of▁sentence｜>",
+                    "tool▁call▁begin"),
+    "phi4_mini_json": ('functools[{"name": "get_weather", '
+                       '"arguments": {"city": "Paris"}}]',
+                       "functools"),
+}
+
+
+@pytest.mark.parametrize("mode", sorted(_FAMILY_CASES))
+def test_family_tool_round_trip(mode):
+    completion, prompt_marker = _FAMILY_CASES[mode]
+    tools = [{"type": "function", "function": {
+        "name": "get_weather", "description": "d",
+        "parameters": {"type": "object",
+                       "properties": {"city": {"type": "string"}}}}}]
+    prompt = render_tools_prompt(tools, mode=mode)
+    assert "get_weather" in prompt
+    assert prompt_marker in prompt, f"{mode} prompt lacks its own format"
+    msg = parse_message(completion, tool_mode=mode)
+    assert msg.finish_reason == "tool_calls", (mode, completion)
+    call = msg.tool_calls[0]["function"]
+    assert call["name"] == "get_weather"
+    assert json.loads(call["arguments"]) == {"city": "Paris"}
+    assert msg.content == ""
+
+
+@pytest.mark.parametrize("mode", sorted(_FAMILY_CASES))
+def test_family_prose_is_not_a_tool_call(mode):
+    """Plain prose — including prose that quotes JSON mid-sentence —
+    must never parse as a call in any mode."""
+    msg = parse_message("The weather tool takes a city argument, e.g. "
+                        '"Paris", and returns a forecast.',
+                        tool_mode=mode)
+    assert not msg.tool_calls
+    assert msg.finish_reason is None
+
+
+def test_hermes_fallback_when_model_drifts():
+    """A llama3_json-mode model that answers hermes-style (the prompt
+    example format of a multi-model client) still parses."""
+    msg = parse_message('<tool_call>{"name": "get_weather", '
+                        '"arguments": {"city": "Paris"}}</tool_call>',
+                        tool_mode="llama3_json")
+    assert msg.tool_calls[0]["function"]["name"] == "get_weather"
 
 
 def test_server_chat_emits_tool_calls(monkeypatch):
