@@ -312,13 +312,12 @@ class InferenceEngine:
         self._capacity_tokens = (num_pages - 1) * cfg.page_size
         self.host_kv = None
         if cfg.host_kv_offload_bytes > 0:
-            if self.pp_exec is not None:
-                # the stage-split [S, L/S, pages, ...] layout moves the
-                # page dim; PP keeps the preempt-recompute fallback
+            if self.pp_exec is not None and jax.process_count() > 1:
+                # spilling a pipeline-sharded pool needs per-host shard
+                # handling; multi-process PP keeps preempt-recompute
                 logger.warning(
-                    "host KV offload does not cover pipeline-parallel "
-                    "cache layouts; PP engines fall back to "
-                    "preempt-recompute")
+                    "host KV offload is not supported on multi-process "
+                    "pipeline engines; falling back to preempt-recompute")
             else:
                 from kaito_tpu.engine.host_offload import HostKVPool
 
@@ -1620,7 +1619,7 @@ class InferenceEngine:
         slot = self.slots[slot_idx]
         req = slot.request
         if self.host_kv is None or req.kv_import is not None \
-                or slot.prefilling:
+                or req.kv_chunked is not None or slot.prefilling:
             return
         written = slot.position
         n_pages = -(-written // self.cfg.page_size)
@@ -1634,9 +1633,12 @@ class InferenceEngine:
         bucket = 1 << (n_pages - 1).bit_length()
         ids = np.zeros((bucket,), np.int32)
         ids[:n_pages] = slot.pages[:n_pages]
-        k_pages, v_pages = gather_pages(self.cache.k, self.cache.v,
-                                        jnp.asarray(ids))
-        if self.host_kv.put(req.req_id, k_pages, v_pages, written):
+        page_axis = 2 if self.pp_exec is not None else 1
+        k_pages, v_pages = gather_pages(
+            self.cache.k, self.cache.v, jnp.asarray(ids),
+            page_axis=page_axis)
+        if self.host_kv.put(req.req_id, k_pages, v_pages, written,
+                            page_axis=page_axis):
             self.counters["host_kv_spilled_pages_total"] += n_pages
         # else: entry can never fit; resume recomputes
 
@@ -1653,18 +1655,20 @@ class InferenceEngine:
             return False    # stale entry: fall back to recompute
         # mirror the spill's power-of-two padding; pad slots target the
         # null page, whose content is garbage by design
-        bucket = entry.k.shape[1]
+        page_axis = 2 if self.pp_exec is not None else 1
+        bucket = entry.k.shape[page_axis]
         ids = np.zeros((bucket,), np.int32)
         ids[:n_pages] = slot.pages[:n_pages]
         ids, ek, ev = jnp.asarray(ids), entry.k, entry.v
-        if self.mesh is not None:
+        mesh = self.mesh or (self.pp_exec.mesh if self.pp_exec else None)
+        if mesh is not None:
             # host-pool entries are committed to the host device; the
             # pool spans the mesh — replicate the operands first so the
             # jitted scatter sees one consistent device set
             from jax.sharding import NamedSharding
             from jax.sharding import PartitionSpec as P
 
-            repl = NamedSharding(self.mesh, P())
+            repl = NamedSharding(mesh, P())
             ids, ek, ev = (jax.device_put(x, repl) for x in (ids, ek, ev))
         k, v = self._scatter_pages_fn()(self.cache.k, self.cache.v,
                                         ids, ek, ev)
@@ -1688,18 +1692,26 @@ class InferenceEngine:
         return True
 
     def _scatter_pages_fn(self):
-        """Jitted restore-scatter; under a TP mesh the donated pool is
-        pinned to its original sharding so restores never re-lay-out
+        """Jitted restore-scatter; under a TP/PP mesh the donated pool
+        is pinned to its original sharding so restores never re-lay-out
         the cache (which would recompile every decode program)."""
         fn = getattr(self, "_scatter_jit", None)
         if fn is None:
+            from functools import partial as _partial
+
             from kaito_tpu.engine.host_offload import _scatter_impl
 
             kw = {}
-            if self.mesh is not None:
+            page_axis = 1
+            if self.pp_exec is not None:
+                page_axis = 2
+                kw["out_shardings"] = (self.cache.k.sharding,
+                                       self.cache.v.sharding)
+            elif self.mesh is not None:
                 sh = self._cache_sharding()
                 kw["out_shardings"] = (sh, sh)
-            fn = jax.jit(_scatter_impl, donate_argnums=(0, 1), **kw)
+            fn = jax.jit(_partial(_scatter_impl, page_axis=page_axis),
+                         donate_argnums=(0, 1), **kw)
             self._scatter_jit = fn
         return fn
 

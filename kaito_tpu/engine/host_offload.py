@@ -12,10 +12,11 @@ so D2H overlaps decode), and re-admission scatters them back into
 freshly acquired pages instead of recomputing the whole prefix.
 
 Dropping an entry is always safe: resume falls back to the recompute
-path the scheduler already has.  Covers single-chip and TP engines
-(the gather/scatter page-id contract is layout-independent; the TP
-engine pins the restored pool's sharding via out_shardings); the PP
-stage-split layout keeps the recompute fallback.
+path the scheduler already has.  Covers single-chip, TP, and
+single-process PP engines (the page-id contract is layout-independent;
+``page_axis=2`` addresses the stage-split [S, L/S, pages, ...] pool,
+and the engine pins the restored pool's sharding via out_shardings);
+multi-process PP keeps the recompute fallback.
 """
 
 from __future__ import annotations
@@ -23,9 +24,11 @@ from __future__ import annotations
 import collections
 import logging
 from dataclasses import dataclass
+from functools import partial
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 logger = logging.getLogger(__name__)
@@ -33,10 +36,11 @@ logger = logging.getLogger(__name__)
 
 @dataclass
 class HostKVEntry:
-    k: jax.Array          # [L, n_pages, H, ps, D] on the host backend
-    v: jax.Array
+    k: jax.Array          # [L, n_pages, ps, H, D] on the host backend
+    v: jax.Array          # ([S, L/S, n_pages, ...] on PP engines)
     written: int          # tokens whose KV the pages hold
     nbytes: int
+    n_pages: int          # padded page-bucket size (layout-independent)
 
 
 class HostKVPool:
@@ -58,7 +62,8 @@ class HostKVPool:
     def __len__(self) -> int:
         return len(self._entries)
 
-    def put(self, req_id: str, k, v, written: int) -> bool:
+    def put(self, req_id: str, k, v, written: int,
+            page_axis: int = 1) -> bool:
         """Store a spilled sequence; returns False if it can never fit."""
         self.discard(req_id)   # same-key overwrite must not double-count
         nbytes = k.nbytes + v.nbytes
@@ -72,10 +77,11 @@ class HostKVPool:
             # async D2H: enqueued ahead of any later donating step
             k = jax.device_put(k, self._host_dev)
             v = jax.device_put(v, self._host_dev)
-        self._entries[req_id] = HostKVEntry(k=k, v=v, written=written,
-                                            nbytes=nbytes)
+        self._entries[req_id] = HostKVEntry(
+            k=k, v=v, written=written, nbytes=nbytes,
+            n_pages=k.shape[page_axis])
         self.used_bytes += nbytes
-        self.spilled_pages += k.shape[1]
+        self.spilled_pages += k.shape[page_axis]
         return True
 
     def has(self, req_id: str) -> bool:
@@ -85,7 +91,7 @@ class HostKVPool:
         entry = self._entries.pop(req_id, None)
         if entry is not None:
             self.used_bytes -= entry.nbytes
-            self.restored_pages += entry.k.shape[1]
+            self.restored_pages += entry.n_pages
         return entry
 
     def discard(self, req_id: str) -> None:
@@ -94,17 +100,21 @@ class HostKVPool:
             self.used_bytes -= entry.nbytes
 
 
-@jax.jit
-def gather_pages(cache_k, cache_v, ids):
-    """Copy pages out of the pools: [L, P, H, ps, D] -> [L, n, ...]
-    (specializes per page count — bounded by pages_per_seq)."""
-    return cache_k[:, ids], cache_v[:, ids]
+@partial(jax.jit, static_argnames=("page_axis",))
+def gather_pages(cache_k, cache_v, ids, page_axis: int = 1):
+    """Copy pages out of the pools: [L, P, ps, H, D] -> [L, n, ...]
+    (specializes per page count — bounded by pages_per_seq).
+    ``page_axis=2`` covers the pipeline-staged layout [S, L/S, P, ...]."""
+    return (jnp.take(cache_k, ids, axis=page_axis),
+            jnp.take(cache_v, ids, axis=page_axis))
 
 
-def _scatter_impl(cache_k, cache_v, ids, k_pages, v_pages):
+def _scatter_impl(cache_k, cache_v, ids, k_pages, v_pages,
+                  page_axis: int = 1):
     """Write spilled pages back into freshly acquired page slots.
     (Unjitted body: the engine jits it per-instance —
-    ``_scatter_pages_fn`` — with explicit out_shardings under a TP mesh
-    so the donated pool keeps its head-dim sharding across restores.)"""
-    return (cache_k.at[:, ids].set(k_pages.astype(cache_k.dtype)),
-            cache_v.at[:, ids].set(v_pages.astype(cache_v.dtype)))
+    ``_scatter_pages_fn`` — with explicit out_shardings under a TP/PP
+    mesh so the donated pool keeps its sharding across restores.)"""
+    idx = (slice(None),) * page_axis + (ids,)
+    return (cache_k.at[idx].set(k_pages.astype(cache_k.dtype)),
+            cache_v.at[idx].set(v_pages.astype(cache_v.dtype)))

@@ -885,6 +885,9 @@ def main(argv=None):
                          "(0 disables; reference contract "
                          "inference_api.py:503-556)")
     ap.add_argument("--max-queue-len", type=int, default=256)
+    ap.add_argument("--max-pages", type=int, default=0,
+                    help="KV page-pool size override (0 = size from "
+                         "free HBM; vLLM num_gpu_blocks_override parity)")
     ap.add_argument("--speculative-ngram", type=int,
                     default=int(os.environ.get("KAITO_SPEC_NGRAM", "0")),
                     help="prompt-lookup speculative decoding: propose up "
@@ -922,6 +925,7 @@ def main(argv=None):
             args.kaito_kv_cache_cpu_memory_utilization
             * os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES")),
         max_queue_len=args.max_queue_len,
+        max_pages=args.max_pages,
         speculative_ngram=args.speculative_ngram,
     )
     if args.kaito_config_file:

@@ -103,3 +103,28 @@ def test_host_pool_roundtrip_and_lru():
     assert pool2.evicted_entries == 1
     # oversized entry is refused outright
     assert not HostKVPool(max_bytes=8).put("big", k, v, 1)
+
+
+def test_spill_restore_under_pp():
+    """Round-4: host offload covers the pipeline-staged cache layout
+    ([S, L/S, pages, ...]) — a preempted sequence on a pp=2 engine
+    spills, restores, and matches the offload-free greedy output."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    solo = InferenceEngine(EngineConfig(**BASE))
+    solo.start()
+    try:
+        b_ref = list(solo.submit([50, 51, 52] * 11, _greedy(40)).stream())
+    finally:
+        solo.stop()
+
+    cfg = EngineConfig(**BASE, pipeline_parallel=2, pp_microbatches=2,
+                       host_kv_offload_bytes=256 * 2**20)
+    eng, a_out, b_out = _run_pair(cfg)
+    assert len(a_out) == 100 and len(b_out) == 40
+    assert b_out == b_ref
+    assert eng.counters["preemptions_total"] >= 1
+    assert eng.counters["host_kv_spilled_pages_total"] >= 1
+    assert eng.counters["host_kv_restored_pages_total"] >= 1
