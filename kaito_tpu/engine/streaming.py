@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import struct
 import time
 import urllib.error
@@ -153,15 +154,56 @@ def gcp_metadata_token() -> str:
     return _gcp_token_cache["token"]
 
 
+def env_token() -> str:
+    """Static bearer token from KAITO_STREAM_TOKEN (pre-provisioned
+    secrets / cross-cloud SAS-style tokens).  Fails fast when unset —
+    an empty Bearer header would surface as opaque 401s per ranged
+    GET instead of one diagnosable startup error."""
+    tok = os.environ.get("KAITO_STREAM_TOKEN", "")
+    if not tok:
+        raise RuntimeError(
+            "weights location uses the +token scheme but "
+            "KAITO_STREAM_TOKEN is unset (secret mount missing?)")
+    return tok
+
+
+# Pluggable credential-exchange registry (the analogue of the
+# reference's per-cloud streamer credential init containers,
+# preset_inferences.go runai_streamer + SAS-token flow): scheme ->
+# (base_url_builder, token_provider).  Extend by registering a scheme;
+# the GCS entry is the GKE-native default.
+def _gcs_base(location: str) -> str:
+    bucket, _, prefix = location[len("gs://"):].partition("/")
+    base = f"https://storage.googleapis.com/{bucket}"
+    return base + (f"/{prefix}" if prefix else "")
+
+
+CREDENTIAL_PROVIDERS: dict = {
+    "gs": (_gcs_base, gcp_metadata_token),
+    "https+token": (lambda loc: "https://" + loc.split("://", 1)[1],
+                    env_token),
+    "http+token": (lambda loc: "http://" + loc.split("://", 1)[1],
+                   env_token),
+}
+
+
+def register_credential_provider(scheme: str, base_builder, token_provider):
+    """Add a blob-store scheme (e.g. an S3/Azure signer): the streaming
+    loader resolves ``scheme://...`` weight locations through it."""
+    CREDENTIAL_PROVIDERS[scheme] = (base_builder, token_provider)
+
+
 def make_reader(location: str) -> HTTPRangeReader:
-    """gs://bucket/prefix -> GCS JSON-API media endpoint; http(s) URLs
-    pass through (tests, plain mirrors)."""
-    if location.startswith("gs://"):
-        bucket, _, prefix = location[len("gs://"):].partition("/")
-        base = f"https://storage.googleapis.com/{bucket}"
-        if prefix:
-            base += f"/{prefix}"
-        return HTTPRangeReader(base, token_provider=gcp_metadata_token)
+    """Resolve a weights location through the credential registry:
+    ``gs://`` uses the GKE metadata server, ``http(s)+token://`` a
+    pre-provisioned env token, plain http(s) passes through (tests,
+    public mirrors)."""
+    scheme = location.split("://", 1)[0] if "://" in location else ""
+    entry = CREDENTIAL_PROVIDERS.get(scheme)
+    if entry is not None:
+        base_builder, token_provider = entry
+        return HTTPRangeReader(base_builder(location),
+                               token_provider=token_provider or None)
     return HTTPRangeReader(location)
 
 

@@ -152,3 +152,26 @@ def test_single_file_fallback(tmp_path):
         np.testing.assert_array_equal(t, sd["model.norm.weight"])
     finally:
         srv.shutdown()
+
+
+def test_credential_registry_routes_schemes(weights_server, monkeypatch):
+    """The pluggable credential-exchange registry (reference analogue:
+    per-cloud streamer credential init containers): http+token://
+    attaches the env token; custom schemes register and resolve."""
+    from kaito_tpu.engine import streaming
+
+    _, _, _, base_url, _ = weights_server
+    host = base_url.rsplit("://", 1)[1]
+    monkeypatch.setenv("KAITO_STREAM_TOKEN", "sekret-token")
+    r = streaming.make_reader(f"http+token://{host}")
+    assert r.base_url.startswith("http://")
+    assert r.token_provider() == "sekret-token"
+
+    monkeypatch.setitem(streaming.CREDENTIAL_PROVIDERS, "unittest",
+                        (lambda loc: base_url, lambda: "custom-cred"))
+    r2 = streaming.make_reader("unittest://whatever/path")
+    assert r2.base_url == base_url
+    assert r2.token_provider() == "custom-cred"
+    # a registered-scheme reader still actually reads
+    data = r2.read("model-00001-of-00002.safetensors", 0, 8)
+    assert len(data) == 8
