@@ -187,9 +187,6 @@ class InferenceEngine:
         self.tokenizer = load_tokenizer(self.md.hf_id, arch.vocab_size)
         self.pp_exec = None
         if cfg.pipeline_parallel > 1:
-            if cfg.pd_enabled:
-                raise ValueError("P/D disaggregation is not supported with "
-                                 "pipeline-parallel serving")
             if mesh is not None:
                 raise ValueError("pipeline-parallel serving builds its own "
                                  "(pipeline, tensor) mesh; an explicit mesh "

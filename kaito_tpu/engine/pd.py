@@ -145,16 +145,34 @@ def import_kv(cache: KVCache, pages: list[int], payload: bytes,
 
 def import_arrays(cache: KVCache, pages: list[int], k: np.ndarray,
                   v: np.ndarray) -> KVCache:
-    """Scatter fully-assembled [L, n_pages, ...] K/V into the pool in
-    ONE device update (the single-copy cost a chunked receive pays at
-    completion)."""
-    expect = (cache.k.shape[0], len(pages)) + tuple(cache.k.shape[2:])
+    """Scatter fully-assembled canonical [L, n_pages, ...] K/V into the
+    pool in ONE device update (the single-copy cost a chunked receive
+    pays at completion).
+
+    The wire layout is CANONICAL (layer-major) regardless of either
+    engine's parallelism: a pipeline-staged pool ([S, L/S, P, ...],
+    ndim 6) reshapes the slab to stage-major before the scatter, so a
+    pp-prefill engine can hand KV to a flat-TP decode engine and vice
+    versa."""
+    staged = cache.k.ndim == k.ndim + 1
+    L = (cache.k.shape[0] * cache.k.shape[1]) if staged else cache.k.shape[0]
+    expect = (L, len(pages)) + tuple(cache.k.shape[3 if staged else 2:])
     if tuple(k.shape) != expect:
         raise ValueError(f"KV shape mismatch: got {k.shape}, cache wants {expect}")
-    idx = jnp.asarray(pages, jnp.int32)
     dt = cache.k.dtype
-    return KVCache(k=cache.k.at[:, idx].set(jnp.asarray(k, dt)),
-                   v=cache.v.at[:, idx].set(jnp.asarray(v, dt)))
+    idx = jnp.asarray(pages, jnp.int32)
+    kj, vj = jnp.asarray(k, dt), jnp.asarray(v, dt)
+    if staged:
+        # each slab reshapes with its OWN trailing dims (MLA caches
+        # carry a zero-size V tail, so V must not borrow K's shape)
+        S = cache.k.shape[0]
+        return KVCache(
+            k=cache.k.at[:, :, idx].set(
+                kj.reshape((S, L // S) + k.shape[1:])),
+            v=cache.v.at[:, :, idx].set(
+                vj.reshape((S, L // S) + v.shape[1:])))
+    return KVCache(k=cache.k.at[:, idx].set(kj),
+                   v=cache.v.at[:, idx].set(vj))
 
 
 def pack_transfer(meta: dict, payload: bytes) -> bytes:
@@ -270,10 +288,21 @@ def stage_export(cache: KVCache, pages: list[int], *, n_tokens: int,
                  model: str, prompt_tokens: list[int],
                  first_token: int) -> StagedExport:
     """Engine-thread entry: on-device gather + chunk plan; returns the
-    staged export whose copier is already draining."""
+    staged export whose copier is already draining.
+
+    A pipeline-staged pool ([S, L/S, P, ...]) gathers on the page axis
+    and reshapes to the CANONICAL layer-major wire layout, so the
+    receiving engine's parallelism doesn't have to match."""
     idx = jnp.asarray(pages, jnp.int32)
-    k_dev = cache.k[:, idx]              # compact [L, n, ps, Hkv, D]
-    v_dev = cache.v[:, idx]
+    if cache.k.ndim == 6:                # stage-split pool
+        S, Lps = cache.k.shape[0], cache.k.shape[1]
+        k_dev = cache.k[:, :, idx].reshape((S * Lps, len(pages))
+                                           + cache.k.shape[3:])
+        v_dev = cache.v[:, :, idx].reshape((S * Lps, len(pages))
+                                           + cache.v.shape[3:])
+    else:
+        k_dev = cache.k[:, idx]          # compact [L, n, ps, Hkv, D]
+        v_dev = cache.v[:, idx]
     L, n_pages = int(k_dev.shape[0]), int(k_dev.shape[1])
     per_layer_page = 2 * int(np.prod(k_dev.shape[2:])) * k_dev.dtype.itemsize
     plans = plan_chunks(L, n_pages, per_layer_page)

@@ -99,12 +99,55 @@ def test_pp_tp_chunked_prefill_parity():
 
 
 def test_pp_guards():
+    # ep must divide the expert count (0 experts on a dense model)
     with pytest.raises(ValueError, match="expert"):
         InferenceEngine(EngineConfig(**{**BASE, "pipeline_parallel": 2,
                                         "expert_parallel": 2}))
-    with pytest.raises(ValueError, match="P/D"):
-        InferenceEngine(EngineConfig(**{**BASE, "pipeline_parallel": 2,
-                                        "pd_enabled": True}))
+
+
+def test_pd_handoff_across_layouts():
+    """Round-4: the KV wire layout is canonical (layer-major), so a
+    pipeline-staged prefill engine hands KV to a FLAT decode engine —
+    and the reverse — with exact greedy parity (beyond the reference,
+    whose NIXL hand-off requires matching worker layouts)."""
+    prompt = list(range(3, 40))
+    p = SamplingParams(max_tokens=10, temperature=0.0, ignore_eos=True)
+    pd_base = dict(BASE, pd_enabled=True)
+
+    ref = InferenceEngine(EngineConfig(**pd_base))
+    ref.start()
+    ref_out = list(ref.submit(prompt, p).stream())
+    ref.stop()
+
+    def handoff(prod_cfg, cons_cfg):
+        prod = InferenceEngine(EngineConfig(**prod_cfg))
+        prod.start()
+        try:
+            pre = prod.submit(prompt, SamplingParams(
+                max_tokens=1, temperature=0.0, ignore_eos=True),
+                export_kv=True)
+            first = list(pre.stream())[0]
+            staged = prod.kv_exports.pop(pre.req_id)
+            staged.wait_all()
+            blob = staged.whole_blob()
+            meta = staged.meta
+        finally:
+            prod.stop()
+        cons = InferenceEngine(EngineConfig(**cons_cfg))
+        cons.start()
+        try:
+            req = cons.submit_with_kv(prompt, first, meta, blob, p)
+            list(req.stream())
+            assert req.finish_reason != "error"
+            return list(req.output_tokens)
+        finally:
+            cons.stop()
+
+    pp_cfg = dict(pd_base, pipeline_parallel=2, pp_microbatches=2)
+    # pp prefill -> flat decode
+    assert handoff(pp_cfg, pd_base) == ref_out
+    # flat prefill -> pp decode
+    assert handoff(pd_base, pp_cfg) == ref_out
 
 
 def test_planner_pp_wiring():
