@@ -187,6 +187,13 @@ class InferenceEngine:
         self.tokenizer = load_tokenizer(self.md.hf_id, arch.vocab_size)
         self.pp_exec = None
         if cfg.pipeline_parallel > 1:
+            if cfg.pd_enabled and jax.process_count() > 1:
+                # exporting a pipeline-sharded pool needs every stage's
+                # shard on this host; multi-process PP can't gather it
+                raise ValueError(
+                    "P/D disaggregation is not supported on MULTI-PROCESS "
+                    "pipeline engines (the staged KV pool spans hosts); "
+                    "single-process PP composes with PD")
             if mesh is not None:
                 raise ValueError("pipeline-parallel serving builds its own "
                                  "(pipeline, tensor) mesh; an explicit mesh "

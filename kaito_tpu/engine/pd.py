@@ -123,15 +123,30 @@ def deserialize_chunk(payload: bytes) -> tuple[np.ndarray, np.ndarray]:
 # one-shot export/import (DP-local hand-off and small transfers)
 # ---------------------------------------------------------------------------
 
+def _gather_canonical(cache: KVCache, pages: list[int]):
+    """Device gather of a request's pages in the CANONICAL layer-major
+    layout, from either a flat ([L, P, ...]) or pipeline-staged
+    ([S, L/S, P, ...]) pool."""
+    idx = jnp.asarray(pages, jnp.int32)
+    if cache.k.ndim == 6:                # stage-split pool
+        S, Lps = cache.k.shape[0], cache.k.shape[1]
+        return (cache.k[:, :, idx].reshape((S * Lps, len(pages))
+                                           + cache.k.shape[3:]),
+                cache.v[:, :, idx].reshape((S * Lps, len(pages))
+                                           + cache.v.shape[3:]))
+    return cache.k[:, idx], cache.v[:, idx]
+
+
 def export_kv(cache: KVCache, pages: list[int]) -> tuple[dict, bytes]:
-    """Gather a request's pages to host in one shot.
+    """Gather a request's pages to host in one shot (canonical wire
+    layout, layout-independent like stage_export).
 
     Returns (meta, payload).  The chunked path below supersedes this for
     serving; it remains the simple primitive for tests and in-process
     hand-off."""
-    idx = jnp.asarray(pages, jnp.int32)
-    k = np.asarray(cache.k[:, idx])      # [L, n, ps, Hkv, D]
-    v = np.asarray(cache.v[:, idx])
+    k_dev, v_dev = _gather_canonical(cache, pages)
+    k = np.asarray(k_dev)                # [L, n, ps, Hkv, D]
+    v = np.asarray(v_dev)
     meta = {"shape": list(k.shape), "dtype": str(k.dtype)}
     return meta, serialize_chunk(k, v)
 
@@ -293,16 +308,7 @@ def stage_export(cache: KVCache, pages: list[int], *, n_tokens: int,
     A pipeline-staged pool ([S, L/S, P, ...]) gathers on the page axis
     and reshapes to the CANONICAL layer-major wire layout, so the
     receiving engine's parallelism doesn't have to match."""
-    idx = jnp.asarray(pages, jnp.int32)
-    if cache.k.ndim == 6:                # stage-split pool
-        S, Lps = cache.k.shape[0], cache.k.shape[1]
-        k_dev = cache.k[:, :, idx].reshape((S * Lps, len(pages))
-                                           + cache.k.shape[3:])
-        v_dev = cache.v[:, :, idx].reshape((S * Lps, len(pages))
-                                           + cache.v.shape[3:])
-    else:
-        k_dev = cache.k[:, idx]          # compact [L, n, ps, Hkv, D]
-        v_dev = cache.v[:, idx]
+    k_dev, v_dev = _gather_canonical(cache, pages)
     L, n_pages = int(k_dev.shape[0]), int(k_dev.shape[1])
     per_layer_page = 2 * int(np.prod(k_dev.shape[2:])) * k_dev.dtype.itemsize
     plans = plan_chunks(L, n_pages, per_layer_page)

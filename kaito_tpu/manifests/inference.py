@@ -44,8 +44,20 @@ def build_engine_command(
     adapters_dir: str = "",
 ) -> list[str]:
     """The pod command (analogue of buildVLLMInferenceCommand
-    ``pkg/model/interface.go:374`` + configureParallelism ``:500``)."""
+    ``pkg/model/interface.go:374`` + configureParallelism ``:500``).
+
+    Long-tail presets (``runtime: transformers``) render the HF
+    fallback runtime instead — the reference's vLLM-vs-text-generation
+    runtime split (RuntimeName, interface.go)."""
     mesh = plan.mesh
+    if getattr(md, "runtime", "engine") == "transformers":
+        return [
+            "python", "-m", "kaito_tpu.runtime.hf_fallback",
+            "--model", md.hf_id,
+            "--port", str(PORT),
+            "--max-model-len", str(plan.max_model_len),
+            "--served-model-name", md.name or md.hf_id,
+        ]
     args = [
         "python", "-m", "kaito_tpu.engine.server",
         "--model", md.name if md.name else md.hf_id,
@@ -169,16 +181,25 @@ def generate_inference_workload(
                                   "mountPath": f"/mnt/adapters/{a.name}"}],
             })
 
+    fallback = getattr(md, "runtime", "engine") == "transformers"
+    if fallback:
+        # CPU torch runtime: no TPU chips to pin, and the engine
+        # self-benchmark probe would 400 on small-context long-tail
+        # models (input_len 2048 > n_positions) — plain HTTP probes
+        resources = {"requests": {"cpu": "4", "memory": "16Gi"}}
+        benchmark = False
+    else:
+        resources = {
+            "requests": {"google.com/tpu": str(plan.chip.chips_per_host)},
+            "limits": {"google.com/tpu": str(plan.chip.chips_per_host)},
+        }
     container = {
         "name": "engine",
         "image": image,
         "command": cmd,
         "env": engine_env(ws, md, plan),
         "ports": [{"containerPort": PORT}],
-        "resources": {
-            "requests": {"google.com/tpu": str(plan.chip.chips_per_host)},
-            "limits": {"google.com/tpu": str(plan.chip.chips_per_host)},
-        },
+        "resources": resources,
         "volumeMounts": mounts,
         **_probes(num_hosts, benchmark),
     }

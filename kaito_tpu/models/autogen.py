@@ -214,12 +214,24 @@ def metadata_from_hf_config(
     """Auto-generate a preset from a HF config dict (reference:
     ``GeneratePreset``, ``presets/workspace/generator/generator.go:805``)."""
     archs = cfg.get("architectures") or []
+    runtime = "engine"
     if archs and not (set(archs) & SUPPORTED_ARCHITECTURES):
-        raise ValueError(
-            f"unsupported architecture {archs!r} for {hf_id}; "
-            f"supported: {sorted(SUPPORTED_ARCHITECTURES)}"
-        )
+        # long-tail architecture: serve via the HF transformers
+        # fallback runtime (reference: the text-generation runtime for
+        # models vLLM can't serve) — the generic ModelArch extraction
+        # below still sizes capacity planning
+        runtime = "transformers"
     arch = arch_from_hf_config(cfg)
+    if runtime == "transformers" and not (
+            arch.hidden_size > 0 and arch.num_layers > 0
+            and arch.num_heads > 0):
+        # non-transformer config (Mamba/encoder-decoder/vision): the
+        # generic dims are garbage and would drive capacity planning to
+        # a too-small instance — refuse loudly instead
+        raise ValueError(
+            f"architecture {archs!r} for {hf_id} is not "
+            f"transformer-shaped (no usable hidden/layers/heads dims); "
+            f"cannot size capacity for the fallback runtime")
     quant = quantization or str(
         (cfg.get("quantization_config") or {}).get("quant_method", "")
     )
@@ -234,7 +246,8 @@ def metadata_from_hf_config(
         token_limit=arch.max_position_embeddings,
         download_auth_required=download_auth_required,
         quantization=quant,
-        tags=tags,
+        tags=tags + (("fallback-runtime",) if runtime != "engine" else ()),
         tool_call_parser=tool_parser,
         reasoning_parser=reasoning_parser,
+        runtime=runtime,
     )

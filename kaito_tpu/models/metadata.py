@@ -169,6 +169,11 @@ class ModelMetadata:
     reasoning_parser: str = ""
     chat_template: str = ""        # chat template preset name
     tags: tuple[str, ...] = ()
+    # "engine" = the first-party JAX engine; "transformers" = the HF
+    # fallback runtime for long-tail architectures (reference:
+    # RuntimeName in pkg/model/interface.go + the text-generation
+    # transformers runtime)
+    runtime: str = "engine"
 
     @property
     def file_bytes(self) -> int:

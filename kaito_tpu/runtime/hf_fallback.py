@@ -1,0 +1,219 @@
+"""HF transformers fallback runtime for long-tail architectures.
+
+The counterpart of the reference's text-generation runtime
+(``presets/workspace/inference/text-generation/inference_api.py``): the
+first-party JAX engine covers the catalog's model families; anything
+else (an architecture the engine has no layer implementation for)
+serves through HuggingFace ``transformers`` on torch behind the SAME
+OpenAI surface, so every model the reference can serve has a serving
+path here too.  The workload generator selects this runtime from the
+preset's ``runtime: transformers`` (``models/autogen`` flips it for
+unsupported architectures).
+
+Deliberately small: stdlib HTTP, greedy/temperature sampling loop,
+local-files-only model loading (zero-egress parity), byte-level
+tokenizer fallback when the checkpoint ships no tokenizer.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+logger = logging.getLogger(__name__)
+
+
+class FallbackState:
+    def __init__(self, model_path: str, max_model_len: int = 2048,
+                 served_name: str = ""):
+        import os
+
+        import torch
+        from transformers import AutoModelForCausalLM, AutoTokenizer
+
+        self.torch = torch
+        t0 = time.monotonic()
+        # local first (ModelMirror PVC / pre-warmed cache); when absent
+        # and egress is allowed, download like the reference's
+        # text-generation runtime does at startup (KAITO_OFFLINE=1
+        # forces the zero-egress behavior)
+        offline = os.environ.get("KAITO_OFFLINE", "") == "1"
+        try:
+            self.model = AutoModelForCausalLM.from_pretrained(
+                model_path, local_files_only=True, dtype=torch.float32)
+        except OSError:
+            if offline:
+                raise
+            logger.info("no local copy of %s; downloading", model_path)
+            self.model = AutoModelForCausalLM.from_pretrained(
+                model_path, dtype=torch.float32)
+        self.model.eval()
+        try:
+            self.tokenizer = AutoTokenizer.from_pretrained(
+                model_path, local_files_only=True)
+        except Exception:
+            from kaito_tpu.engine.tokenizer import ByteTokenizer
+
+            logger.warning("no tokenizer files at %s; byte-level fallback",
+                           model_path)
+            self.tokenizer = ByteTokenizer()
+        self.max_model_len = max_model_len
+        self.served_name = served_name or model_path.rstrip("/").rsplit(
+            "/", 1)[-1]
+        self.lock = threading.Lock()   # one generation at a time (CPU)
+        self.counters = {"requests_total": 0, "generation_tokens_total": 0}
+        logger.info("fallback runtime ready in %.1fs (%s)",
+                    time.monotonic() - t0, self.served_name)
+
+    def generate(self, token_ids: list[int], max_tokens: int,
+                 temperature: float, seed: int = 0,
+                 ignore_eos: bool = False) -> tuple[list[int], str]:
+        """Returns (tokens, finish_reason).  The EOS token itself is
+        never emitted (OpenAI semantics: it terminates, it isn't
+        content) and a max_tokens cutoff reports "length"."""
+        torch = self.torch
+        eos = getattr(self.tokenizer, "eos_token_id", None)
+        gen = torch.Generator().manual_seed(seed or 0)
+        ids = torch.tensor([token_ids], dtype=torch.long)
+        out: list[int] = []
+        finish = "length"
+        with self.lock, torch.no_grad():
+            past = None
+            cur = ids
+            for _ in range(max_tokens):
+                res = self.model(cur, past_key_values=past, use_cache=True)
+                past = res.past_key_values
+                logits = res.logits[0, -1]
+                if temperature and temperature > 0.0:
+                    probs = torch.softmax(logits / temperature, dim=-1)
+                    nxt = int(torch.multinomial(probs, 1, generator=gen))
+                else:
+                    nxt = int(torch.argmax(logits))
+                if eos is not None and nxt == eos and not ignore_eos:
+                    finish = "stop"
+                    break
+                out.append(nxt)
+                cur = torch.tensor([[nxt]], dtype=torch.long)
+        self.counters["requests_total"] += 1
+        self.counters["generation_tokens_total"] += len(out)
+        return out, finish
+
+
+def make_fallback_server(state: FallbackState, host: str = "0.0.0.0",
+                         port: int = 5000) -> ThreadingHTTPServer:
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _json(self, code: int, body: dict):
+            data = json.dumps(body).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            if self.path == "/health":
+                self._json(200, {"status": "ok",
+                                 "runtime": "transformers-fallback"})
+            elif self.path == "/v1/models":
+                self._json(200, {"object": "list", "data": [
+                    {"id": state.served_name, "object": "model",
+                     "owned_by": "kaito-tpu-fallback"}]})
+            elif self.path == "/metrics":
+                lines = [f"kaito:{k} {v}" for k, v in
+                         state.counters.items()]
+                data = ("\n".join(lines) + "\n").encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+            else:
+                self._json(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            try:
+                body = json.loads(self.rfile.read(n) or b"{}")
+            except json.JSONDecodeError:
+                return self._json(400, {"error": "invalid JSON"})
+            chat = self.path == "/v1/chat/completions"
+            if self.path not in ("/v1/completions", "/v1/chat/completions"):
+                return self._json(404, {"error": f"no route {self.path}"})
+            if chat:
+                messages = body.get("messages") or []
+                apply = getattr(state.tokenizer, "apply_chat_template", None)
+                try:
+                    prompt = apply(messages, tokenize=False,
+                                   add_generation_prompt=True)
+                except Exception:
+                    prompt = "".join(
+                        f"<|{m.get('role', 'user')}|>\n"
+                        f"{m.get('content', '')}\n" for m in messages
+                    ) + "<|assistant|>\n"
+            else:
+                prompt = body.get("prompt", "")
+                if isinstance(prompt, list):
+                    prompt = prompt[0] if prompt else ""
+            toks = state.tokenizer.encode(prompt)
+            max_tokens = int(body.get("max_tokens", 16))
+            if len(toks) + max_tokens > state.max_model_len:
+                return self._json(400, {"error": {
+                    "message": f"prompt+max_tokens exceeds "
+                               f"{state.max_model_len}",
+                    "type": "invalid_request_error"}})
+            out, finish = state.generate(
+                toks, max_tokens, float(body.get("temperature", 1.0)),
+                seed=int(body.get("seed", 0) or 0),
+                ignore_eos=bool(body.get("ignore_eos", False)))
+            text = state.tokenizer.decode(out)
+            rid = f"cmpl-{uuid.uuid4().hex[:20]}"
+            usage = {"prompt_tokens": len(toks),
+                     "completion_tokens": len(out),
+                     "total_tokens": len(toks) + len(out)}
+            if chat:
+                self._json(200, {
+                    "id": rid, "object": "chat.completion",
+                    "model": state.served_name,
+                    "choices": [{"index": 0, "finish_reason": finish,
+                                 "message": {"role": "assistant",
+                                             "content": text}}],
+                    "usage": usage})
+            else:
+                self._json(200, {
+                    "id": rid, "object": "text_completion",
+                    "model": state.served_name,
+                    "choices": [{"index": 0, "text": text,
+                                 "finish_reason": finish}],
+                    "usage": usage})
+
+    return ThreadingHTTPServer((host, port), Handler)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="kaito-tpu-hf-fallback")
+    ap.add_argument("--model", required=True,
+                    help="local checkpoint dir or cached HF id")
+    ap.add_argument("--port", type=int, default=5000)
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--max-model-len", type=int, default=2048)
+    ap.add_argument("--served-model-name", default="")
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    state = FallbackState(args.model, max_model_len=args.max_model_len,
+                          served_name=args.served_model_name)
+    srv = make_fallback_server(state, host=args.host, port=args.port)
+    logger.info("fallback runtime serving %s on %s:%d", state.served_name,
+                args.host, args.port)
+    srv.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,103 @@
+"""HF transformers fallback runtime: long-tail architectures (anything
+the first-party engine has no layer implementation for) serve through
+transformers behind the same OpenAI surface — the reference's
+text-generation runtime analogue, closing the one intentionally-open
+row in the round-3 component inventory."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from kaito_tpu.models.autogen import metadata_from_hf_config
+
+GPT2_CFG = {"architectures": ["GPT2LMHeadModel"], "model_type": "gpt2",
+            "n_embd": 32, "n_layer": 2, "n_head": 2, "n_positions": 128,
+            "vocab_size": 300}
+
+
+@pytest.fixture(scope="module")
+def tiny_gpt2(tmp_path_factory):
+    """A real (random-weight) GPT2 checkpoint on disk — an architecture
+    the JAX engine does NOT implement."""
+    torch = pytest.importorskip("torch")
+    from transformers import GPT2Config, GPT2LMHeadModel
+
+    path = tmp_path_factory.mktemp("gpt2")
+    cfg = GPT2Config(n_embd=32, n_layer=2, n_head=2, n_positions=128,
+                     vocab_size=300)
+    torch.manual_seed(0)
+    GPT2LMHeadModel(cfg).save_pretrained(str(path))
+    return str(path)
+
+
+def test_autogen_routes_long_tail_to_fallback_runtime():
+    md = metadata_from_hf_config("openai-community/gpt2", GPT2_CFG,
+                                 name="gpt2-test")
+    assert md.runtime == "transformers"
+    assert "fallback-runtime" in md.tags
+    # capacity planning still sees real dims
+    assert md.arch.num_layers == 2 and md.arch.hidden_size == 32
+
+
+def test_workload_renders_fallback_command():
+    from kaito_tpu.api import InferenceSpec, ObjectMeta, ResourceSpec, Workspace
+    from kaito_tpu.manifests.inference import build_engine_command
+    from kaito_tpu.parallel.plan import plan_parallelism
+    from kaito_tpu.sku.catalog import CHIP_CATALOG
+
+    md = metadata_from_hf_config("openai-community/gpt2", GPT2_CFG,
+                                 name="gpt2-test")
+    plan = plan_parallelism(md, CHIP_CATALOG["v5e"], workload="serve",
+                            max_model_len=128)
+    ws = Workspace(ObjectMeta(name="lt"),
+                   resource=ResourceSpec(instance_type="ct5lp-hightpu-1t"),
+                   inference=InferenceSpec(preset="gpt2-test"))
+    cmd = build_engine_command(ws, md, plan)
+    assert cmd[:3] == ["python", "-m", "kaito_tpu.runtime.hf_fallback"]
+    assert "--model" in cmd and "openai-community/gpt2" in cmd
+
+
+def test_fallback_serves_openai_surface(tiny_gpt2):
+    from kaito_tpu.runtime.hf_fallback import (
+        FallbackState,
+        make_fallback_server,
+    )
+
+    state = FallbackState(tiny_gpt2, max_model_len=128)
+    srv = make_fallback_server(state, host="127.0.0.1", port=0)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        health = json.loads(urllib.request.urlopen(
+            base + "/health", timeout=10).read())
+        assert health["runtime"] == "transformers-fallback"
+
+        def post(path, body):
+            req = urllib.request.Request(
+                base + path, data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            return json.loads(urllib.request.urlopen(req, timeout=120).read())
+
+        out = post("/v1/completions", {"prompt": "hello", "max_tokens": 6,
+                                       "temperature": 0.0,
+                                       "ignore_eos": True})
+        assert out["usage"]["completion_tokens"] == 6
+        # greedy determinism
+        out2 = post("/v1/completions", {"prompt": "hello", "max_tokens": 6,
+                                        "temperature": 0.0,
+                                        "ignore_eos": True})
+        assert out2["choices"][0]["text"] == out["choices"][0]["text"]
+
+        chat = post("/v1/chat/completions", {
+            "messages": [{"role": "user", "content": "hi"}],
+            "max_tokens": 4, "temperature": 0.0, "ignore_eos": True})
+        assert chat["choices"][0]["message"]["role"] == "assistant"
+        assert chat["usage"]["completion_tokens"] == 4
+
+        mx = urllib.request.urlopen(base + "/metrics",
+                                    timeout=10).read().decode()
+        assert "kaito:generation_tokens_total" in mx
+    finally:
+        srv.shutdown()
