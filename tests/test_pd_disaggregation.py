@@ -199,3 +199,36 @@ def test_pd_decode_rejects_bad_source(pd_pair):
                             "first_token": 0, "force": True}})
     assert e.value.code == 502
 
+
+
+def test_pd_chunked_transfer_stall_fails_request():
+    """A transfer whose chunks stop arriving must fail the request
+    after the arrival deadline (freeing its slot) — without wedging
+    the engine for other traffic.  max_num_seqs=1 makes the
+    slot-freeing assertion real: the follow-up request can only admit
+    into the slot the failed transfer released."""
+    eng = InferenceEngine(EngineConfig(**{**CFG, "max_num_seqs": 1}))
+    eng.start()
+    try:
+        from kaito_tpu.engine.pd import plan_chunks
+
+        plans = plan_chunks(4, 2, 1024)
+        meta = {"shape": [4, 2, 16, 4, 8], "dtype": "float32",
+                "model": "tiny-llama-test",
+                "chunks": [p.to_json() for p in plans]}
+        req = eng.submit_with_kv_chunked([1, 2, 3], 5, meta, plans,
+                                         SamplingParams(max_tokens=4,
+                                                        temperature=0.0,
+                                                        ignore_eos=True),
+                                         deadline_s=1.0)
+        # feed NOTHING: the puller died upstream
+        out = list(req.stream())
+        assert out == []
+        assert req.finish_reason == "error"
+        # the engine still serves new traffic afterwards
+        ok = eng.submit([4, 5, 6], SamplingParams(max_tokens=4,
+                                                  temperature=0.0,
+                                                  ignore_eos=True))
+        assert len(list(ok.stream())) == 4
+    finally:
+        eng.stop()
