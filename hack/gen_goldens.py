@@ -1,15 +1,18 @@
-"""Generate golden logprobs/continuations for the committed
-tiny-llama-real checkpoint.
+"""Generate golden logprobs/continuations for a committed checkpoint.
 
-Boots the REAL serving engine from checkpoints/tiny-llama-real (the
-same weights_dir path production uses), scores fixed prompts through
-the completions echo+logprobs surface, and records greedy
-continuations — bf16-load, rope, scoring, and sampling correctness all
-pin to these numbers (tests/test_real_checkpoint.py).
+Boots the REAL serving engine from checkpoints/<model> (the same
+weights_dir path production uses), scores fixed prompts through the
+completions echo+logprobs surface, and records greedy continuations —
+bf16-load, rope, MoE routing, scoring, and sampling correctness all
+pin to these numbers (tests/test_real_checkpoint.py, parametrized over
+every committed checkpoint).
 
-Run after (re)training: python hack/gen_goldens.py
+Run after (re)training a model:
+  python hack/train_tiny_real.py --model <name>
+  python hack/gen_goldens.py --model <name>
 """
 
+import argparse
 import json
 import os
 
@@ -18,8 +21,6 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-CKPT = os.path.join(REPO, "checkpoints", "tiny-llama-real")
-OUT = os.path.join(REPO, "tests", "testdata", "tiny_real_goldens.json")
 
 PROMPTS = [
     "This package provides a",
@@ -29,15 +30,22 @@ PROMPTS = [
 
 
 def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="tiny-llama-real")
+    args = ap.parse_args()
+    ckpt = os.path.join(REPO, "checkpoints", args.model)
+    out_path = os.path.join(REPO, "tests", "testdata",
+                            f"goldens_{args.model}.json")
+
     from kaito_tpu.engine.config import EngineConfig
     from kaito_tpu.engine.engine import InferenceEngine, SamplingParams
 
-    golden = {"checkpoint": "checkpoints/tiny-llama-real",
+    golden = {"checkpoint": f"checkpoints/{args.model}",
               "report": json.load(open(os.path.join(
-                  CKPT, "training_report.json"))),
+                  ckpt, "training_report.json"))),
               "prompts": []}
     for quant in ("", "int8"):
-        cfg = EngineConfig(model="tiny-llama-real", weights_dir=CKPT,
+        cfg = EngineConfig(model=args.model, weights_dir=ckpt,
                            dtype="float32", kv_dtype="float32",
                            max_model_len=512, max_num_seqs=2,
                            prefill_buckets=(64, 128),
@@ -65,9 +73,9 @@ def main():
                 }
         finally:
             eng.stop()
-    with open(OUT, "w") as f:
+    with open(out_path, "w") as f:
         json.dump(golden, f, indent=1)
-    print("wrote", OUT)
+    print("wrote", out_path)
     for p in golden["prompts"]:
         print(f"  {p['text']!r}: fp32 {p['fp32']['greedy_tokens'][:6]}...")
 

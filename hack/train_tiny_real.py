@@ -36,7 +36,6 @@ import jax.numpy as jnp
 import numpy as np
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-OUT = os.path.join(REPO, "checkpoints", "tiny-llama-real")
 
 
 def build_corpus(max_bytes: int = 6_000_000) -> bytes:
@@ -82,9 +81,12 @@ def main():
     ap.add_argument("--batch", type=int, default=4)
     ap.add_argument("--seqlen", type=int, default=256)
     ap.add_argument("--lr", type=float, default=3e-3)
+    ap.add_argument("--model", default="tiny-llama-real",
+                    help="preset to train (e.g. tiny-moe-real)")
     ap.add_argument("--tpu", action="store_true",
                     help="train on the accelerator instead of CPU")
     args = ap.parse_args()
+    out_dir = os.path.join(REPO, "checkpoints", args.model)
 
     import optax
 
@@ -101,7 +103,7 @@ def main():
           f"(train {len(train) / 1e6:.2f}M, val {len(val) / 1e3:.0f}k bytes)",
           flush=True)
 
-    md = get_model_by_name("tiny-llama-real")
+    md = get_model_by_name(args.model)
     model = TransformerLM(md.arch, dtype=jnp.float32)
     params = model.init_params(jax.random.PRNGKey(0))
     sched = optax.warmup_cosine_decay_schedule(
@@ -139,15 +141,15 @@ def main():
     val_bpb = float(np.mean(vlosses) / np.log(2))
     print(f"held-out: {val_bpb:.3f} bits/byte", flush=True)
 
-    os.makedirs(OUT, exist_ok=True)
+    os.makedirs(out_dir, exist_ok=True)
     from safetensors.numpy import save_file
 
     sd = export_hf_state_dict(model, state.params)
     sd = {k: np.asarray(v, np.dtype("bfloat16")) if v.dtype == np.float32
           else np.asarray(v) for k, v in sd.items()}
-    save_file(sd, os.path.join(OUT, "model.safetensors"))
+    save_file(sd, os.path.join(out_dir, "model.safetensors"))
     report = {
-        "model": "tiny-llama-real",
+        "model": args.model,
         "params_m": round(sum(x.size for x in jax.tree.leaves(
             state.params)) / 1e6, 2),
         "corpus_bytes": len(corpus),
@@ -158,9 +160,9 @@ def main():
         "heldout_bits_per_byte": round(val_bpb, 3),
         "tokenizer": "byte-level (vocab 258)",
     }
-    with open(os.path.join(OUT, "training_report.json"), "w") as f:
+    with open(os.path.join(out_dir, "training_report.json"), "w") as f:
         json.dump(report, f, indent=2)
-    print("saved", OUT, flush=True)
+    print("saved", out_dir, flush=True)
 
 
 if __name__ == "__main__":

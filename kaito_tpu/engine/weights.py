@@ -176,6 +176,8 @@ def assemble_params(model: TransformerLM,
                         fused = fused.T
                         I = arch.intermediate_size
                         tensor = fused[:, :I] if our_key == "gate" else fused[:, I:]
+                if tensor is None and g.moe:
+                    tensor = _read_moe_tensor(get, arch, li, our_key)
                 if tensor is None:
                     raise KeyError(
                         f"no source tensor for layer {li} key {our_key!r}")
@@ -183,6 +185,70 @@ def assemble_params(model: TransformerLM,
         params[g.name] = {
             k: put(g.name, k, np.stack(v)) for k, v in stack.items()}
     return params
+
+
+# MoE tensors don't fit the flat suffix map: HF stores one tensor per
+# expert (mixtral `block_sparse_moe.experts.{e}.w{1,2,3}`, qwen/deepseek
+# `mlp.experts.{e}.{gate,up,down}_proj`), ours stack over the expert
+# dim.  w1=gate, w3=up, w2=down (mixtral's numbering).
+_MOE_EXPERT_SUFFIXES = {
+    "experts_gate": ("w1", "gate_proj"),
+    "experts_up": ("w3", "up_proj"),
+    "experts_down": ("w2", "down_proj"),
+}
+_MOE_SHARED = {
+    "shared_gate": "gate_proj",
+    "shared_up": "up_proj",
+    "shared_down": "down_proj",
+}
+
+
+def _read_moe_tensor(get, arch, li: int, our_key: str):
+    """Load-side MoE mapping: router / stacked experts / shared experts
+    from either HF naming convention; None when absent."""
+    if our_key == "router":
+        for suffix in ("block_sparse_moe.gate.weight", "mlp.gate.weight"):
+            t = get(f"layers.{li}.{suffix}", required=False)
+            if t is not None:
+                return t.T                          # [X, H] -> [H, X]
+        return None
+    if our_key in _MOE_EXPERT_SUFFIXES:
+        mix, qwen = _MOE_EXPERT_SUFFIXES[our_key]
+        per_expert = []
+        for e in range(arch.num_experts):
+            t = get(f"layers.{li}.block_sparse_moe.experts.{e}.{mix}.weight",
+                    required=False)
+            if t is None:
+                t = get(f"layers.{li}.mlp.experts.{e}.{qwen}.weight",
+                        required=False)
+            if t is None:
+                return None
+            per_expert.append(t.T)                  # HF [out, in] -> ours
+        return np.stack(per_expert)
+    if our_key in _MOE_SHARED:
+        t = get(f"layers.{li}.mlp.shared_experts."
+                f"{_MOE_SHARED[our_key]}.weight", required=False)
+        return None if t is None else t.T
+    return None
+
+
+def _export_moe_tensor(out: dict, li: int, our_key: str, t: np.ndarray):
+    """Export-side inverse of _read_moe_tensor (mixtral naming)."""
+    if our_key == "router":
+        out[f"model.layers.{li}.block_sparse_moe.gate.weight"] = \
+            np.ascontiguousarray(t.T)
+        return True
+    if our_key in _MOE_EXPERT_SUFFIXES:
+        mix, _ = _MOE_EXPERT_SUFFIXES[our_key]
+        for e in range(t.shape[0]):
+            out[f"model.layers.{li}.block_sparse_moe.experts.{e}"
+                f".{mix}.weight"] = np.ascontiguousarray(t[e].T)
+        return True
+    if our_key in _MOE_SHARED:
+        out[f"model.layers.{li}.mlp.shared_experts."
+            f"{_MOE_SHARED[our_key]}.weight"] = np.ascontiguousarray(t.T)
+        return True
+    return False
 
 
 def export_hf_state_dict(model: TransformerLM, params: dict) -> dict[str, np.ndarray]:
@@ -202,6 +268,10 @@ def export_hf_state_dict(model: TransformerLM, params: dict) -> dict[str, np.nda
         for our_key, stack in params[g.name].items():
             entry = layer_map.get(our_key)
             if entry is None:
+                if g.moe:
+                    for i in range(g.count):
+                        _export_moe_tensor(out, g.start + i, our_key,
+                                           np.asarray(stack[i]))
                 continue
             suffix, transpose = entry
             for i in range(g.count):

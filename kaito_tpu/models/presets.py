@@ -314,6 +314,17 @@ _add("tiny-llama-real", "kaito-tpu/tiny-llama-real",
             scaling=None),
      tags=("test", "real-checkpoint"))
 
+# MoE sibling: same corpus/tokenizer, mixtral-style 4-expert stack —
+# pins router/expert-dispatch correctness end-task alongside the dense
+# goldens (checkpoints/tiny-moe-real)
+_add("tiny-moe-real", "kaito-tpu/tiny-moe-real",
+     {"architectures": ["MixtralForCausalLM"], "model_type": "mixtral",
+      "vocab_size": 258, "hidden_size": 128, "num_hidden_layers": 2,
+      "num_attention_heads": 4, "num_key_value_heads": 2,
+      "intermediate_size": 256, "num_local_experts": 4,
+      "num_experts_per_tok": 2, "max_position_embeddings": 2048},
+     tags=("test", "real-checkpoint"))
+
 
 def register_builtin_presets() -> None:
     for md in _PRESETS:

@@ -67,3 +67,39 @@ def test_missing_tensor_reports_name(tmp_path):
     save_file(sd, str(tmp_path / "model.safetensors"))
     with pytest.raises(KeyError, match="down"):
         load_safetensors_params(model, str(tmp_path))
+
+
+def test_moe_checkpoint_roundtrip(tmp_path):
+    """MoE checkpoints round-trip: router + per-expert w1/w2/w3
+    (mixtral naming) export and re-load with identical logits — the
+    path real Mixtral/Qwen-MoE/DeepSeek checkpoints come in through."""
+    from safetensors.numpy import save_file
+
+    from kaito_tpu.engine.kv_cache import create_kv_cache
+    from kaito_tpu.models.autogen import arch_from_hf_config
+
+    arch = arch_from_hf_config({
+        "architectures": ["MixtralForCausalLM"], "model_type": "mixtral",
+        "vocab_size": 258, "hidden_size": 64, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "num_key_value_heads": 2,
+        "intermediate_size": 96, "num_local_experts": 4,
+        "num_experts_per_tok": 2, "max_position_embeddings": 256})
+    model = TransformerLM(arch, dtype=jnp.float32)
+    params = model.init_params(jax.random.PRNGKey(3))
+    sd = export_hf_state_dict(model, params)
+    assert any("block_sparse_moe.experts.3.w2" in k for k in sd)
+    assert any("block_sparse_moe.gate" in k for k in sd)
+    save_file({k: np.asarray(v) for k, v in sd.items()},
+              str(tmp_path / "model.safetensors"))
+    loaded = load_safetensors_params(model, str(tmp_path))
+
+    rng = np.random.RandomState(0)
+    toks = jnp.asarray(rng.randint(0, 258, (1, 16)), jnp.int32)
+    tl = jnp.asarray([16], jnp.int32)
+    pt = jnp.asarray(np.arange(1, 3, dtype=np.int32)[None])
+    _, l1, _ = model.prefill(params, create_kv_cache(arch, 4, 16, jnp.float32),
+                             toks, tl, pt)
+    _, l2, _ = model.prefill(loaded, create_kv_cache(arch, 4, 16, jnp.float32),
+                             toks, tl, pt)
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2),
+                               rtol=1e-6, atol=1e-6)
