@@ -70,23 +70,28 @@ class FallbackState:
         logger.info("fallback runtime ready in %.1fs (%s)",
                     time.monotonic() - t0, self.served_name)
 
-    def generate(self, token_ids: list[int], max_tokens: int,
-                 temperature: float, seed: int = 0,
-                 ignore_eos: bool = False) -> tuple[list[int], str]:
-        """Returns (tokens, finish_reason).  The EOS token itself is
-        never emitted (OpenAI semantics: it terminates, it isn't
-        content) and a max_tokens cutoff reports "length"."""
+    def stream_tokens(self, token_ids: list[int], max_tokens: int,
+                      temperature: float, seed: int = 0,
+                      ignore_eos: bool = False):
+        """Yield generated token ids one at a time; the generator's
+        ``finish`` attribute-carrier is returned via StopIteration
+        value ("stop" on EOS, "length" on cutoff).  The EOS token
+        itself is never emitted (OpenAI semantics)."""
         torch = self.torch
         eos = getattr(self.tokenizer, "eos_token_id", None)
         gen = torch.Generator().manual_seed(seed or 0)
         ids = torch.tensor([token_ids], dtype=torch.long)
-        out: list[int] = []
         finish = "length"
-        with self.lock, torch.no_grad():
+        try:
             past = None
             cur = ids
             for _ in range(max_tokens):
-                res = self.model(cur, past_key_values=past, use_cache=True)
+                # lock per STEP, never across a yield: the consumer does
+                # network I/O between tokens, and a stalled SSE client
+                # must not stall every other request
+                with self.lock, torch.no_grad():
+                    res = self.model(cur, past_key_values=past,
+                                     use_cache=True)
                 past = res.past_key_values
                 logits = res.logits[0, -1]
                 if temperature and temperature > 0.0:
@@ -97,11 +102,26 @@ class FallbackState:
                 if eos is not None and nxt == eos and not ignore_eos:
                     finish = "stop"
                     break
-                out.append(nxt)
+                self.counters["generation_tokens_total"] += 1
+                yield nxt
                 cur = torch.tensor([[nxt]], dtype=torch.long)
-        self.counters["requests_total"] += 1
-        self.counters["generation_tokens_total"] += len(out)
-        return out, finish
+        finally:
+            # counted even when the consumer disconnects mid-stream
+            self.counters["requests_total"] += 1
+        return finish
+
+    def generate(self, token_ids: list[int], max_tokens: int,
+                 temperature: float, seed: int = 0,
+                 ignore_eos: bool = False) -> tuple[list[int], str]:
+        """Collect stream_tokens: (tokens, finish_reason)."""
+        out: list[int] = []
+        it = self.stream_tokens(token_ids, max_tokens, temperature,
+                                seed=seed, ignore_eos=ignore_eos)
+        while True:
+            try:
+                out.append(next(it))
+            except StopIteration as s:
+                return out, s.value or "length"
 
 
 def make_fallback_server(state: FallbackState, host: str = "0.0.0.0",
@@ -169,6 +189,8 @@ def make_fallback_server(state: FallbackState, host: str = "0.0.0.0",
                     "message": f"prompt+max_tokens exceeds "
                                f"{state.max_model_len}",
                     "type": "invalid_request_error"}})
+            if body.get("stream"):
+                return self._stream(chat, toks, max_tokens, body)
             out, finish = state.generate(
                 toks, max_tokens, float(body.get("temperature", 1.0)),
                 seed=int(body.get("seed", 0) or 0),
@@ -193,6 +215,69 @@ def make_fallback_server(state: FallbackState, host: str = "0.0.0.0",
                     "choices": [{"index": 0, "text": text,
                                  "finish_reason": finish}],
                     "usage": usage})
+
+        def _stream(self, chat: bool, toks: list[int], max_tokens: int,
+                    body: dict):
+            """SSE streaming (OpenAI chunk shape), one token per event."""
+            rid = f"cmpl-{uuid.uuid4().hex[:20]}"
+            obj = "chat.completion.chunk" if chat else "text_completion"
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.end_headers()
+
+            def emit(payload: dict):
+                self.wfile.write(b"data: " + json.dumps(payload).encode()
+                                 + b"\n\n")
+                self.wfile.flush()
+
+            it = state.stream_tokens(
+                toks, max_tokens, float(body.get("temperature", 1.0)),
+                seed=int(body.get("seed", 0) or 0),
+                ignore_eos=bool(body.get("ignore_eos", False)))
+            finish = "length"
+            out_toks: list[int] = []
+            prev_text = ""
+            try:
+                if chat:
+                    # OpenAI chat streams open with the role delta
+                    emit({"id": rid, "object": obj,
+                          "model": state.served_name,
+                          "choices": [{"index": 0, "finish_reason": None,
+                                       "delta": {"role": "assistant"}}]})
+                while True:
+                    try:
+                        tok = next(it)
+                    except StopIteration as s:
+                        finish = s.value or "length"
+                        break
+                    out_toks.append(tok)
+                    # incremental full-sequence decode: per-id decode
+                    # garbles multi-byte codepoints / SentencePiece
+                    # space markers (see engine token_surface_forms)
+                    text = state.tokenizer.decode(out_toks)
+                    piece, prev_text = text[len(prev_text):], text
+                    if chat:
+                        choice = {"index": 0, "finish_reason": None,
+                                  "delta": {"content": piece}}
+                    else:
+                        choice = {"index": 0, "finish_reason": None,
+                                  "text": piece}
+                    emit({"id": rid, "object": obj,
+                          "model": state.served_name, "choices": [choice]})
+                final = {"index": 0, "finish_reason": finish}
+                if chat:
+                    final["delta"] = {}
+                else:
+                    final["text"] = ""
+                emit({"id": rid, "object": obj, "model": state.served_name,
+                      "choices": [final]})
+                self.wfile.write(b"data: [DONE]\n\n")
+                self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError):
+                pass     # client went away mid-stream
+            finally:
+                it.close()
 
     return ThreadingHTTPServer((host, port), Handler)
 

@@ -101,3 +101,44 @@ def test_fallback_serves_openai_surface(tiny_gpt2):
         assert "kaito:generation_tokens_total" in mx
     finally:
         srv.shutdown()
+
+
+def test_fallback_streams_sse(tiny_gpt2):
+    from kaito_tpu.runtime.hf_fallback import (
+        FallbackState,
+        make_fallback_server,
+    )
+
+    state = FallbackState(tiny_gpt2, max_model_len=128)
+    srv = make_fallback_server(state, host="127.0.0.1", port=0)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{srv.server_address[1]}"
+    try:
+        req = urllib.request.Request(
+            base + "/v1/completions",
+            data=json.dumps({"prompt": "hi", "max_tokens": 4,
+                             "temperature": 0.0, "ignore_eos": True,
+                             "stream": True}).encode(),
+            headers={"Content-Type": "application/json"})
+        raw = urllib.request.urlopen(req, timeout=120).read().decode()
+        events = [json.loads(l[len("data: "):])
+                  for l in raw.splitlines()
+                  if l.startswith("data: ") and l != "data: [DONE]"]
+        assert raw.strip().endswith("data: [DONE]")
+        assert len(events) == 5                       # 4 tokens + final
+        assert events[-1]["choices"][0]["finish_reason"] == "length"
+        assert all(e["choices"][0]["finish_reason"] is None
+                   for e in events[:-1])
+        # streamed pieces reassemble to the non-streamed text exactly
+        streamed = "".join(e["choices"][0].get("text", "")
+                           for e in events)
+        req2 = urllib.request.Request(
+            base + "/v1/completions",
+            data=json.dumps({"prompt": "hi", "max_tokens": 4,
+                             "temperature": 0.0,
+                             "ignore_eos": True}).encode(),
+            headers={"Content-Type": "application/json"})
+        flat = json.loads(urllib.request.urlopen(req2, timeout=120).read())
+        assert streamed == flat["choices"][0]["text"]
+    finally:
+        srv.shutdown()
