@@ -101,6 +101,7 @@ def plan_chunks(n_layers: int, n_pages: int, bytes_per_layer_page: int,
 
 def serialize_chunk(k: np.ndarray, v: np.ndarray) -> bytes:
     head = json.dumps({"shape": list(k.shape),
+                       "v_shape": list(v.shape),
                        "dtype": str(k.dtype)}).encode()
     return head + b"\n" + k.tobytes() + v.tobytes()
 
@@ -108,14 +109,18 @@ def serialize_chunk(k: np.ndarray, v: np.ndarray) -> bytes:
 def deserialize_chunk(payload: bytes) -> tuple[np.ndarray, np.ndarray]:
     head, _, body = payload.partition(b"\n")
     meta = json.loads(head)
-    shape = tuple(meta["shape"])
+    k_shape = tuple(meta["shape"])
+    # V carries its OWN shape: MLA caches hold a zero-size V placeholder
+    # (create_kv_cache), so V must never be assumed K-shaped on the wire.
+    v_shape = tuple(meta.get("v_shape", meta["shape"]))
     dt = np.dtype(meta["dtype"])
-    n = int(np.prod(shape)) * dt.itemsize
-    if len(body) != 2 * n:
-        raise ValueError(f"chunk body is {len(body)} bytes, "
-                         f"expected {2 * n} for shape {shape} {dt}")
-    k = np.frombuffer(body[:n], dt).reshape(shape)
-    v = np.frombuffer(body[n:], dt).reshape(shape)
+    nk = int(np.prod(k_shape)) * dt.itemsize
+    nv = int(np.prod(v_shape)) * dt.itemsize
+    if len(body) != nk + nv:
+        raise ValueError(f"chunk body is {len(body)} bytes, expected "
+                         f"{nk + nv} for K {k_shape} + V {v_shape} {dt}")
+    k = np.frombuffer(body[:nk], dt).reshape(k_shape)
+    v = np.frombuffer(body[nk:], dt).reshape(v_shape)
     return k, v
 
 
@@ -147,7 +152,8 @@ def export_kv(cache: KVCache, pages: list[int]) -> tuple[dict, bytes]:
     k_dev, v_dev = _gather_canonical(cache, pages)
     k = np.asarray(k_dev)                # [L, n, ps, Hkv, D]
     v = np.asarray(v_dev)
-    meta = {"shape": list(k.shape), "dtype": str(k.dtype)}
+    meta = {"shape": list(k.shape), "v_shape": list(v.shape),
+            "dtype": str(k.dtype)}
     return meta, serialize_chunk(k, v)
 
 
@@ -226,6 +232,8 @@ class StagedExport:
         self._error: Optional[str] = None
         self._served = 0
         self._lock = threading.Lock()
+        self._blob_lock = threading.Lock()
+        self._blob: Optional[bytes] = None
         t = threading.Thread(target=self._drain, daemon=True,
                              name="pd-export-copier")
         t.start()
@@ -286,17 +294,25 @@ class StagedExport:
     def whole_blob(self) -> bytes:
         """Assemble the legacy single-payload wire form (meta header +
         one serialized slab covering every page).  Consumes the staged
-        chunks."""
-        self.wait_all()
-        shape = tuple(self.meta["shape"])
-        dt = np.dtype(self.meta["dtype"])
-        k = np.empty(shape, dt)
-        v = np.empty(shape, dt)
-        for i, p in enumerate(self.plans):
-            ck, cv = deserialize_chunk(self.get_chunk(i))
-            k[p.layer_lo:p.layer_hi, p.page_lo:p.page_hi] = ck
-            v[p.layer_lo:p.layer_hi, p.page_lo:p.page_hi] = cv
-        return serialize_chunk(k, v)
+        chunks into a cached blob, so the call is IDEMPOTENT: a retried
+        or concurrent pull gets the same bytes instead of racing the
+        first caller for per-chunk consumption.  Failures before any
+        chunk is consumed (wait_all timeout / copier error) leave the
+        chunks intact for a later retry."""
+        with self._blob_lock:
+            if self._blob is None:
+                self.wait_all()
+                shape = tuple(self.meta["shape"])
+                v_shape = tuple(self.meta.get("v_shape", self.meta["shape"]))
+                dt = np.dtype(self.meta["dtype"])
+                k = np.empty(shape, dt)
+                v = np.empty(v_shape, dt)
+                for i, p in enumerate(self.plans):
+                    ck, cv = deserialize_chunk(self.get_chunk(i))
+                    k[p.layer_lo:p.layer_hi, p.page_lo:p.page_hi] = ck
+                    v[p.layer_lo:p.layer_hi, p.page_lo:p.page_hi] = cv
+                self._blob = serialize_chunk(k, v)
+            return self._blob
 
 
 def stage_export(cache: KVCache, pages: list[int], *, n_tokens: int,
@@ -310,9 +326,11 @@ def stage_export(cache: KVCache, pages: list[int], *, n_tokens: int,
     receiving engine's parallelism doesn't have to match."""
     k_dev, v_dev = _gather_canonical(cache, pages)
     L, n_pages = int(k_dev.shape[0]), int(k_dev.shape[1])
-    per_layer_page = 2 * int(np.prod(k_dev.shape[2:])) * k_dev.dtype.itemsize
+    per_layer_page = int(np.prod(k_dev.shape[2:])
+                         + np.prod(v_dev.shape[2:])) * k_dev.dtype.itemsize
     plans = plan_chunks(L, n_pages, per_layer_page)
     meta = {"shape": [int(s) for s in k_dev.shape],
+            "v_shape": [int(s) for s in v_dev.shape],
             "dtype": str(k_dev.dtype), "n_tokens": n_tokens,
             "model": model, "chunks": [p.to_json() for p in plans]}
     return StagedExport(k_dev, v_dev, meta, plans, prompt_tokens,
@@ -399,9 +417,10 @@ class ChunkedImport:
         self._error: Optional[str] = None
         self._lock = threading.Lock()
         shape = tuple(meta["shape"])
+        v_shape = tuple(meta.get("v_shape", meta["shape"]))
         dt = np.dtype(meta["dtype"])
         self._k_full = np.empty(shape, dt)
-        self._v_full = np.empty(shape, dt)
+        self._v_full = np.empty(v_shape, dt)
 
     @property
     def n_chunks(self) -> int:
@@ -439,9 +458,12 @@ class ChunkedImport:
             k, v = deserialize_chunk(payload)
             expect = (p.layer_hi - p.layer_lo,
                       p.page_hi - p.page_lo) + self._k_full.shape[2:]
-            if tuple(k.shape) != expect:
+            expect_v = (p.layer_hi - p.layer_lo,
+                        p.page_hi - p.page_lo) + self._v_full.shape[2:]
+            if tuple(k.shape) != expect or tuple(v.shape) != expect_v:
                 raise ValueError(f"chunk {idx} shape mismatch: got "
-                                 f"{k.shape}, plan wants {expect}")
+                                 f"K {k.shape} V {v.shape}, plan wants "
+                                 f"K {expect} V {expect_v}")
             self._k_full[p.layer_lo:p.layer_hi, p.page_lo:p.page_hi] = k
             self._v_full[p.layer_lo:p.layer_hi, p.page_lo:p.page_hi] = v
             self.n_scattered += 1
@@ -540,7 +562,8 @@ def bench_kv_handoff(model_name: str, ctxs, on_tpu: bool) -> dict:
             jax.block_until_ready((dest.k, dest.v))
             t_import = time.monotonic() - t1
         total_mb = staged.meta and (
-            2 * int(np.prod(staged.meta["shape"]))
+            (int(np.prod(staged.meta["shape"]))
+             + int(np.prod(staged.meta["v_shape"])))
             * np.dtype(staged.meta["dtype"]).itemsize / 2**20)
         ms = (t_export + t_import) * 1e3
         out[f"pd_handoff_ms@{ctx}"] = round(ms, 1)

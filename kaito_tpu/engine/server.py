@@ -294,18 +294,30 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             return self._error(403, "P/D disaggregation disabled on this pod")
         from kaito_tpu.engine.pd import pack_transfer
 
-        exp = self.state.engine.kv_exports.pop(req_id)
+        # pop is the atomic claim (a concurrent duplicate pull gets a
+        # clean 404, never a chunk-consumption race); on any failure the
+        # export is RE-PUT so the decode side can retry — whole_blob()
+        # is idempotent (cached), so the retry returns the same bytes.
+        reg = self.state.engine.kv_exports
+        exp = reg.pop(req_id)
         if exp is None:
             return self._error(404, f"no staged KV for {req_id}")
         try:
             blob = pack_transfer(exp.meta, exp.whole_blob())
         except Exception as e:
+            reg.put(req_id, exp)
             return self._error(500, f"KV export drain failed: {e}")
-        self.send_response(200)
-        self.send_header("Content-Type", "application/octet-stream")
-        self.send_header("Content-Length", str(len(blob)))
-        self.end_headers()
-        self.wfile.write(blob)
+        try:
+            self.send_response(200)
+            self.send_header("Content-Type", "application/octet-stream")
+            self.send_header("Content-Length", str(len(blob)))
+            self.end_headers()
+            self.wfile.write(blob)
+        except OSError:
+            # client vanished mid-body: keep the export (cached blob)
+            # for the retry; TTL reclaims it if none comes
+            reg.put(req_id, exp)
+            raise
 
     def _pd_kv_meta(self, req_id: str):
         """Chunk-plan handshake: meta (shape/dtype/model/chunk plans)
@@ -329,19 +341,25 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         if exp is None:
             return self._error(404, f"no staged KV for {req_id}")
         try:
-            data = exp.get_chunk(int(idx))
+            # read WITHOUT consuming: a connection that drops mid-write
+            # must leave the chunk staged for the puller's retry
+            data = exp.get_chunk(int(idx), consume=False)
         except (IndexError, ValueError) as e:
             return self._error(400, str(e))
         except KeyError as e:
             return self._error(410, str(e))
         except Exception as e:
             return self._error(500, f"chunk read failed: {e}")
-        reg.drop_served(req_id)
         self.send_response(200)
         self.send_header("Content-Type", "application/octet-stream")
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()
         self.wfile.write(data)
+        try:   # bytes are on the wire: consume, drop entry when drained
+            exp.get_chunk(int(idx))
+        except KeyError:
+            pass   # a duplicate pull raced us; consumed either way
+        reg.drop_served(req_id)
 
     def _submit_with_transfer(self, kv_src: dict, params):
         """Continue decoding from a remote prefill's KV.
@@ -388,11 +406,19 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             # identical; the prefill pod's first token is re-derived).
             logger.info("kv_transfer below break-even (%d tokens); "
                         "recomputing locally", len(prompt_tokens))
-            try:
-                urllib.request.urlopen(urllib.request.Request(
-                    f"{url}/pd/kv/{req_id}", method="DELETE"), timeout=10)
-            except Exception:
-                pass   # TTL reclaims it
+
+            def _release():
+                # off the request path: an unreachable prefill pod must
+                # not add its timeout to a request that no longer needs
+                # it (TTL reclaims the export if this fails)
+                try:
+                    urllib.request.urlopen(urllib.request.Request(
+                        f"{url}/pd/kv/{req_id}", method="DELETE"),
+                        timeout=10)
+                except Exception:
+                    pass
+            threading.Thread(target=_release, daemon=True,
+                             name="pd-release").start()
             return eng.submit(prompt_tokens, params,
                               req_id=f"cmpl-{uuid.uuid4().hex[:20]}")
         try:

@@ -66,6 +66,9 @@ class FallbackState:
         self.served_name = served_name or model_path.rstrip("/").rsplit(
             "/", 1)[-1]
         self.lock = threading.Lock()   # one generation at a time (CPU)
+        # counters get hit from concurrent handler threads outside the
+        # generation lock; they need their own
+        self.counters_lock = threading.Lock()
         self.counters = {"requests_total": 0, "generation_tokens_total": 0}
         logger.info("fallback runtime ready in %.1fs (%s)",
                     time.monotonic() - t0, self.served_name)
@@ -102,12 +105,14 @@ class FallbackState:
                 if eos is not None and nxt == eos and not ignore_eos:
                     finish = "stop"
                     break
-                self.counters["generation_tokens_total"] += 1
+                with self.counters_lock:
+                    self.counters["generation_tokens_total"] += 1
                 yield nxt
                 cur = torch.tensor([[nxt]], dtype=torch.long)
         finally:
             # counted even when the consumer disconnects mid-stream
-            self.counters["requests_total"] += 1
+            with self.counters_lock:
+                self.counters["requests_total"] += 1
         return finish
 
     def generate(self, token_ids: list[int], max_tokens: int,
@@ -147,8 +152,9 @@ def make_fallback_server(state: FallbackState, host: str = "0.0.0.0",
                     {"id": state.served_name, "object": "model",
                      "owned_by": "kaito-tpu-fallback"}]})
             elif self.path == "/metrics":
-                lines = [f"kaito:{k} {v}" for k, v in
-                         state.counters.items()]
+                with state.counters_lock:
+                    snapshot = dict(state.counters)
+                lines = [f"kaito:{k} {v}" for k, v in snapshot.items()]
                 data = ("\n".join(lines) + "\n").encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "text/plain")

@@ -201,6 +201,53 @@ def test_pd_decode_rejects_bad_source(pd_pair):
 
 
 
+def test_pd_mla_roundtrip():
+    """MLA caches carry a ZERO-SIZE V placeholder (create_kv_cache), so
+    the wire format must serialize K and V with their own shapes — a
+    V-assumed-K-shaped wire fails every DeepSeek P/D transfer on the
+    decode side after the prefill compute was already spent."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kaito_tpu.engine.kv_cache import KVCache, create_kv_cache
+    from kaito_tpu.engine.pd import (ChunkedImport, deserialize_chunk,
+                                     import_arrays, stage_export)
+    from kaito_tpu.models.autogen import arch_from_hf_config
+    from tests.test_mla import MLA_CFG
+
+    arch = arch_from_hf_config(MLA_CFG)
+    cache = create_kv_cache(arch, 8, 16, jnp.float32)
+    assert cache.v.shape[-1] == 0          # the MLA placeholder is real
+    rng = np.random.default_rng(0)
+    cache = KVCache(k=jnp.asarray(rng.normal(size=cache.k.shape),
+                                  jnp.float32),
+                    v=cache.v)
+    pages = [1, 3, 4]
+
+    # chunked path: stage -> feed every chunk -> assemble -> scatter
+    staged = stage_export(cache, pages, n_tokens=40, model="mla-test",
+                          prompt_tokens=[], first_token=0)
+    staged.wait_all()
+    assert staged.meta["v_shape"][-1] == 0
+    ci = ChunkedImport(staged.meta, staged.plans, 0)
+    for i in range(staged.n_chunks):
+        ci.feed(i, staged.get_chunk(i, consume=False))
+    while not ci.complete:
+        ci.assemble()
+    k, v = ci.full_arrays()
+    assert v.shape[-1] == 0
+    dest = create_kv_cache(arch, 8, 16, jnp.float32)
+    dest = import_arrays(dest, pages, k, v)
+    np.testing.assert_array_equal(np.asarray(dest.k[:, pages]),
+                                  np.asarray(cache.k[:, pages]))
+
+    # legacy whole-blob path (server's /pd/kv/<id> wire)
+    blob = staged.whole_blob()
+    wk, wv = deserialize_chunk(blob)
+    np.testing.assert_array_equal(wk, np.asarray(cache.k[:, pages]))
+    assert wv.shape[-1] == 0
+
+
 def test_pd_chunked_transfer_stall_fails_request():
     """A transfer whose chunks stop arriving must fail the request
     after the arrival deadline (freeing its slot) — without wedging
