@@ -35,6 +35,13 @@ class EngineConfig:
     tensor_parallel: int = 1             # TP degree (mesh "tensor" axis)
     expert_parallel: int = 1             # EP degree (mesh "expert" axis)
     pipeline_parallel: int = 1           # PP stages (mesh "pipeline" axis)
+    # context-parallel prefill (mesh "sequence" axis): long prompts run
+    # as ONE ring-attention prefill sharded over the sequence axis
+    # instead of serial chunks — TTFT scales ~1/sequence_parallel while
+    # decode stays TP (the KV pool is replicated over the axis).
+    sequence_parallel: int = 1
+    cp_min_tokens: int = 2048            # prompts >= this take the CP path
+    cp_q_tile: int = 1024                # ring query tile (memory bound)
     pp_microbatches: int = 4             # decode microbatches through the ring
     data_parallel: int = 1               # engine replica groups
     use_pallas: Optional[bool] = None    # None = auto (TPU yes, CPU no)

@@ -69,12 +69,13 @@ class DataParallelEngine:
         if cfg.pd_enabled:
             raise ValueError("P/D disaggregation routes KV by page id; "
                              "run it with data_parallel=1 per role")
-        group = max(1, cfg.tensor_parallel) * max(1, cfg.expert_parallel)
+        group = (max(1, cfg.tensor_parallel) * max(1, cfg.expert_parallel)
+                 * max(1, cfg.sequence_parallel))
         devices = jax.devices()
         if len(devices) < dp * group:
             raise ValueError(
-                f"data_parallel={dp} x (tp*ep)={group} needs {dp * group} "
-                f"devices, have {len(devices)}")
+                f"data_parallel={dp} x (sp*ep*tp)={group} needs "
+                f"{dp * group} devices, have {len(devices)}")
         self.cfg = cfg
         self.engines: list[InferenceEngine] = []
         for g in range(dp):
@@ -103,7 +104,8 @@ class DataParallelEngine:
         from kaito_tpu.parallel.mesh import build_mesh
         from kaito_tpu.parallel.plan import make_mesh_spec
 
-        spec = make_mesh_spec(expert=max(1, cfg.expert_parallel),
+        spec = make_mesh_spec(sequence=max(1, cfg.sequence_parallel),
+                              expert=max(1, cfg.expert_parallel),
                               tensor=max(1, cfg.tensor_parallel))
         return build_mesh(spec, devices)
 

@@ -198,10 +198,33 @@ class InferenceEngine:
                 raise ValueError("pipeline-parallel serving builds its own "
                                  "(pipeline, tensor) mesh; an explicit mesh "
                                  "cannot be honored")
+            if cfg.sequence_parallel > 1:
+                logger.warning("sequence_parallel=%d ignored on a pipeline-"
+                               "parallel engine (the stage executor has no "
+                               "sequence axis); long prompts use chunked "
+                               "prefill", cfg.sequence_parallel)
             self.mesh = None       # the PP executor owns the full mesh
             self.pp_exec = self._build_pp_executor()
         else:
             self.mesh = mesh if mesh is not None else self._build_mesh()
+            sp = (dict(self.mesh.shape).get("sequence", 1)
+                  if self.mesh is not None else 1)
+            if sp > 1:
+                if self.model.is_mla:
+                    # MLA's latent stream has no standard q/k/v for the
+                    # ring; long MLA prompts keep the chunked path
+                    logger.warning("sequence_parallel>1 ignored for MLA "
+                                   "models; using chunked prefill")
+                else:
+                    tp_sz = dict(self.mesh.shape).get("tensor", 1)
+                    head_axis = ("tensor" if tp_sz > 1
+                                 and arch.num_heads % tp_sz == 0
+                                 and arch.num_kv_heads % tp_sz == 0
+                                 else None)
+                    self.model.cp = (self.mesh, "sequence", head_axis,
+                                     cfg.cp_q_tile)
+                    logger.info("context-parallel prefill: sequence=%d "
+                                "(head_axis=%s)", sp, head_axis)
 
         if not cfg.max_model_len:
             cfg.max_model_len = min(self.md.max_model_len, 8192)
@@ -396,23 +419,27 @@ class InferenceEngine:
     # ------------------------------------------------------------------
 
     def _build_mesh(self):
-        """TP×EP mesh from config (the planner's tensor/expert axes):
-        weights and KV heads shard across chips, expert stacks place
-        over the expert axis; XLA inserts the collectives."""
+        """SP×EP×TP mesh from config (the planner's sequence/expert/
+        tensor axes): weights and KV heads shard across chips, expert
+        stacks place over the expert axis, long-prompt prefills shard
+        their activations over the sequence axis; XLA inserts the
+        collectives."""
         tp = self.cfg.tensor_parallel
         ep = self.cfg.expert_parallel
+        sp = self.cfg.sequence_parallel
         self._validate_ep(ep)
-        if tp * ep <= 1:
+        if tp * ep * sp <= 1:
             return None
         from kaito_tpu.parallel.mesh import build_mesh
         from kaito_tpu.parallel.plan import make_mesh_spec
 
         devices = jax.devices()
-        if len(devices) < tp * ep:
-            raise ValueError(f"tensor_parallel={tp} x expert_parallel={ep} "
-                             f"but only {len(devices)} devices visible")
-        return build_mesh(make_mesh_spec(expert=ep, tensor=tp),
-                          devices[:tp * ep])
+        if len(devices) < tp * ep * sp:
+            raise ValueError(f"sequence_parallel={sp} x expert_parallel={ep}"
+                             f" x tensor_parallel={tp} but only "
+                             f"{len(devices)} devices visible")
+        return build_mesh(make_mesh_spec(sequence=sp, expert=ep, tensor=tp),
+                          devices[:tp * ep * sp])
 
     def _validate_ep(self, ep: int) -> None:
         if ep > 1 and (self.md.arch.num_experts < ep
@@ -850,6 +877,26 @@ class InferenceEngine:
 
             fn = prefill_step
             self._prefill_fns[bucket] = fn
+        return fn
+
+    def _prefill_cp_fn(self, bucket: int):
+        """Context-parallel single-shot prefill (sequence-axis ring);
+        selected by _advance_prefills for long fresh prompts."""
+        key = ("cp", bucket)
+        fn = self._prefill_fns.get(key)
+        if fn is None:
+            model = self.model
+
+            @partial(jax.jit, donate_argnums=(1,))
+            def prefill_cp(params, cache, tokens, true_lens, page_tables,
+                           adapter_ids):
+                cache, logits, _ = model.prefill_cp(
+                    params, cache, tokens, true_lens, page_tables,
+                    adapter_ids=adapter_ids)
+                return cache, logits
+
+            fn = prefill_cp
+            self._prefill_fns[key] = fn
         return fn
 
     def _prefill_ctx_fn(self, bucket: int):
@@ -1479,6 +1526,15 @@ class InferenceEngine:
         n = len(tokens)
         budget = max(self.cfg.max_prefill_tokens, self.cfg.page_size)
         pos = slot.prefill_pos
+        # long fresh prompts take the context-parallel single-shot path:
+        # the ring shards the memory the chunk budget was bounding, so
+        # the whole prompt runs in ONE dispatch at ~1/seq the latency
+        use_cp = (self.model.cp is not None and pos == 0
+                  and n >= self.cfg.cp_min_tokens
+                  and self._bucket(n) % dict(
+                      self.model.cp[0].shape)["sequence"] == 0)
+        if use_cp:
+            budget = n
         chunk = tokens[pos: pos + budget]
         m = len(chunk)
         bucket = self._bucket(m)
@@ -1486,7 +1542,14 @@ class InferenceEngine:
         ctoks[0, :m] = chunk
         aid = jnp.asarray(self.slot_adapters[i:i + 1])
         try:
-            if pos == 0 and m == n:
+            if use_cp:
+                fn = self._prefill_cp_fn(bucket)
+                self.cache, logits = fn(self.params, self.cache,
+                                        jnp.asarray(ctoks),
+                                        jnp.asarray([m], np.int32),
+                                        jnp.asarray(self.page_tables[i][None]),
+                                        aid)
+            elif pos == 0 and m == n:
                 fn = self._prefill_fn(bucket)
                 self.cache, logits = fn(self.params, self.cache,
                                         jnp.asarray(ctoks),

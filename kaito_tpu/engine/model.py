@@ -73,6 +73,9 @@ class TransformerLM:
         self.attn_impl = attn_impl  # "jax" | "pallas" (paged decode)
         self.lora_scaling = 0.0     # set by the tuner when lora keys exist
         self.ring = None            # (Mesh, axis) => sequence-parallel training
+        # (Mesh, axis, head_axis|None, q_tile) => context-parallel
+        # serving prefill (mode "prefill_cp"); set by the engine
+        self.cp = None
         self.moe_impl = "dense"     # "dense" | "ragged" (grouped matmul)
         self.groups = _layer_groups(arch)
         self.vocab_padded = -(-arch.vocab_size // VOCAB_ALIGN) * VOCAB_ALIGN
@@ -445,7 +448,30 @@ class TransformerLM:
                                          lora=lora, lora_ids=lora_ids)
         ps = ck.shape[-3]
 
-        if mode == "prefill":
+        if mode == "prefill_cp":
+            # context-parallel single-shot prefill: q/k/v are sharded
+            # over the sequence mesh axis; the ring rotates KV shards
+            # while the page-pool scatter below (pool replicated over
+            # the sequence axis) lets GSPMD all-gather the new KV once.
+            # Padding needs no mask of its own: pads sit AFTER true_len,
+            # so causal masking already hides them from valid queries,
+            # and write_prefill_tokens routes their writes to the null
+            # page.  Serving prompts start at position 0 (the engine
+            # gates prefix-cache hits off this path).
+            from kaito_tpu.parallel.ring_attention import ring_attention
+
+            mesh, axis_name, head_axis, q_tile = self.cp
+            start = jnp.zeros((B,), jnp.int32)
+            ck = write_prefill_tokens(ck, k_new, page_tables, start,
+                                      true_lens, ps, layer=li)
+            cv = write_prefill_tokens(cv, v_new, page_tables, start,
+                                      true_lens, ps, layer=li)
+            out = ring_attention(
+                q, k_new, v_new, mesh, axis_name, scale=self._scale,
+                causal=True, sliding_window=window,
+                logit_softcap=a.attn_logit_softcap, head_axis=head_axis,
+                q_tile=q_tile)
+        elif mode == "prefill":
             start = (start_pos if start_pos is not None
                      else jnp.zeros((B,), jnp.int32))
             ck = write_prefill_tokens(ck, k_new, page_tables, start,
@@ -663,6 +689,39 @@ class TransformerLM:
             params, cache, x, "prefill", positions=positions,
             page_tables=page_tables, lengths=true_lens, true_lens=true_lens,
             active=None, start_pos=start_pos, adapter_ids=adapter_ids)
+        x = self._norm(x, params, "final_norm")
+        last = jnp.take_along_axis(
+            x, (true_lens - 1)[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+        return cache, self._logits(params, last), last
+
+    def prefill_cp(self, params, cache: KVCache, tokens, true_lens,
+                   page_tables, adapter_ids=None):
+        """Context-parallel single-shot prefill: the WHOLE prompt in one
+        call, activations sharded over the ``sequence`` mesh axis and
+        attention run as a ring (``parallel/ring_attention.py``).
+
+        The serving-side long-context answer the reference delegates to
+        vLLM's KV budget (``pkg/model/interface.go:308-312``): TTFT for
+        a T-token prompt scales ~1/seq because every chip holds T/seq
+        tokens of activations and attention workspace.  Decode stays TP
+        — the KV pool is replicated over the sequence axis, so the
+        pages this call writes are immediately readable by the ordinary
+        decode step.  Same signature/returns as :meth:`prefill` minus
+        ``start_pos`` (prefix-cache hits take the chunked path).
+        """
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        mesh, axis_name, _, _ = self.cp
+        B, T = tokens.shape
+        rel = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+        x = self._embed(params, tokens)
+        x = jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P(None, axis_name)))
+        x, cache = self._run_layers(
+            params, cache, x, "prefill_cp", positions=rel,
+            page_tables=page_tables, lengths=true_lens, true_lens=true_lens,
+            active=None, adapter_ids=adapter_ids)
         x = self._norm(x, params, "final_norm")
         last = jnp.take_along_axis(
             x, (true_lens - 1)[:, None, None].astype(jnp.int32), axis=1)[:, 0]

@@ -857,6 +857,8 @@ def load_config_file(cfg: EngineConfig, path: str) -> EngineConfig:
         "pipeline_parallel_size": "pipeline_parallel",
         "data-parallel-size": "data_parallel",
         "data_parallel_size": "data_parallel",
+        "sequence-parallel-size": "sequence_parallel",
+        "sequence_parallel_size": "sequence_parallel",
         "page-size": "page_size", "page_size": "page_size",
         "dtype": "dtype", "kv-cache-dtype": "kv_dtype",
         "seed": "seed", "port": "port",
@@ -885,6 +887,12 @@ def main(argv=None):
                     default=int(os.environ.get("KAITO_EXPERT_PARALLEL", "1")))
     ap.add_argument("--data-parallel-size", type=int,
                     default=int(os.environ.get("KAITO_DATA_PARALLEL", "1")))
+    ap.add_argument("--sequence-parallel-size", type=int,
+                    default=int(os.environ.get("KAITO_SEQUENCE_PARALLEL",
+                                               "1")),
+                    help="context-parallel prefill degree (mesh sequence "
+                         "axis; long prompts run one ring-attention "
+                         "dispatch instead of serial chunks)")
     ap.add_argument("--served-model-name", default="")
     ap.add_argument("--dtype", default="")
     ap.add_argument("--quantization", default=os.environ.get(
@@ -938,6 +946,7 @@ def main(argv=None):
         pipeline_parallel=args.pipeline_parallel_size,
         expert_parallel=args.expert_parallel_size,
         data_parallel=args.data_parallel_size,
+        sequence_parallel=args.sequence_parallel_size,
         dtype=args.dtype or ("bfloat16" if on_tpu else "float32"),
         kv_dtype=args.dtype or ("bfloat16" if on_tpu else "float32"),
         adapters_dir=args.kaito_adapters_dir,

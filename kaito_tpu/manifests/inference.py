@@ -80,6 +80,7 @@ def engine_env(ws: Workspace, md: ModelMetadata, plan: ParallelPlan) -> list[dic
         {"name": "KAITO_TENSOR_PARALLEL", "value": str(mesh.size("tensor"))},
         {"name": "KAITO_DATA_PARALLEL", "value": str(mesh.size("data"))},
         {"name": "KAITO_PIPELINE_PARALLEL", "value": str(mesh.size("pipeline"))},
+        {"name": "KAITO_SEQUENCE_PARALLEL", "value": str(mesh.size("sequence"))},
         {"name": "KAITO_COORDINATOR",
          "value": coordinator_address(ws.metadata.name, ws.metadata.namespace)},
         {"name": "KAITO_TPU_TOPOLOGY", "value": plan.topology},

@@ -221,9 +221,22 @@ def plan_parallelism(
         mesh = make_mesh_spec(pipeline=num_slices, fsdp=leftover, expert=expert,
                               sequence=seq, tensor=tp)
     else:
-        # Serving: leftover capacity becomes independent data-parallel
-        # engine replicas (tier 1 when tp == 1).
-        mesh = make_mesh_spec(pipeline=num_slices, data=leftover, tensor=tp)
+        # Serving: long contexts first carve a sequence axis (ring
+        # attention CP prefill — TTFT for a 32k+ prompt scales ~1/seq
+        # while decode stays TP); the rest becomes independent
+        # data-parallel engine replicas (tier 1 when tp == 1).
+        # (single-slice only: the pipeline serving executor owns its
+        # mesh and has no sequence axis — carving one there would
+        # reserve chips the engine never uses)
+        if ctx >= 32768 and leftover >= 2 and num_slices == 1 \
+                and md.arch.attention_kind.value != "MLA":
+            seq = 2
+            while seq * 2 <= leftover and ctx // (seq * 2) >= 8192:
+                seq *= 2
+            leftover //= seq
+            notes.append(f"context-parallel prefill (ring attention) degree {seq}")
+        mesh = make_mesh_spec(pipeline=num_slices, data=leftover,
+                              sequence=seq, tensor=tp)
         if leftover > 1:
             notes.append(f"data parallel serving: {leftover} engine groups of tp={tp}")
 

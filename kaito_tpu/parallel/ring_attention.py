@@ -10,6 +10,15 @@ matmuls.  Causality is handled per-block: a KV block from a later shard
 is skipped, the diagonal block is causally masked, earlier blocks attend
 fully.
 
+Used by BOTH training (long-context packed batches,
+``tuning/trainer.py``) and serving (context-parallel single-shot
+prefill, ``engine/model.py`` mode ``prefill_cp`` — the serving-side CP
+the reference delegates away to vLLM's ``--max-model-len`` budget,
+``pkg/model/interface.go:308-312``).  ``head_axis`` composes CP with
+tensor parallelism: heads stay sharded over the TP axis through the
+ring, so a (sequence x tensor) mesh runs both parallelisms in one
+shard_map.
+
 Pure-collective implementation (lax.ppermute under shard_map) — XLA
 schedules the overlap; a pallas RDMA variant is the planned follow-up.
 """
@@ -27,7 +36,9 @@ from jax.sharding import PartitionSpec as P
 from kaito_tpu.engine.attention import NEG_INF, _gqa_expand
 
 
-def _ring_local(q, k, v, *, axis_name: str, scale: float, causal: bool):
+def _ring_local(q, k, v, sliding_window=None, *, axis_name: str,
+                scale: float, causal: bool, logit_softcap=None,
+                q_tile: int = 0):
     """Local shard computation. q/k/v: [B, T_loc, H(kv), D]."""
     n = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
@@ -38,18 +49,25 @@ def _ring_local(q, k, v, *, axis_name: str, scale: float, causal: bool):
     q_scaled = (q * scale).astype(q.dtype)
     t_local = jnp.arange(T)
 
-    def block(q_, k_, v_, src, m, l, acc):
+    def block(q_, k_, v_, q_pos, src, m, l, acc):
+        """One [Tq, T] score block with online-softmax accumulation.
+        q_pos: [Tq] ABSOLUTE positions of the query rows."""
         kx = _gqa_expand(k_, groups)
         vx = _gqa_expand(v_, groups)
         s = jnp.einsum("bthd,bshd->bhts", q_, kx,
                        preferred_element_type=scores_dtype)
+        if logit_softcap:
+            s = jnp.tanh(s / logit_softcap) * logit_softcap
+        k_pos = src * T + t_local[None, :]
         if causal:
-            q_pos = idx * T + t_local[:, None]
-            k_pos = src * T + t_local[None, :]
-            mask = k_pos <= q_pos
+            mask = k_pos <= q_pos[:, None]
+            if sliding_window is not None:
+                mask &= k_pos > q_pos[:, None] - sliding_window
             s = jnp.where(mask, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        # all-masked blocks keep m at NEG_INF; guard the exp
+        # all-masked blocks keep m at NEG_INF only until the diagonal
+        # block (processed FIRST) seeds it; guard holds because every
+        # causal query row attends at least to itself
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
@@ -58,22 +76,49 @@ def _ring_local(q, k, v, *, axis_name: str, scale: float, causal: bool):
         acc_new = acc * jnp.moveaxis(alpha, 1, 2) + pv
         return m_new, l_new, acc_new
 
-    m0 = jnp.full((B, H, T, 1), NEG_INF, scores_dtype)
-    l0 = jnp.zeros((B, H, T, 1), scores_dtype)
-    acc0 = jnp.zeros((B, T, H, D), scores_dtype)
+    def ring(q_, q_pos):
+        """Run the full ring for one query tile. q_: [B, Tq, H, D]."""
+        Tq = q_.shape[1]
+        m0 = jnp.full((B, H, Tq, 1), NEG_INF, scores_dtype)
+        l0 = jnp.zeros((B, H, Tq, 1), scores_dtype)
+        acc0 = jnp.zeros((B, Tq, H, D), scores_dtype)
 
-    def body(i, carry):
-        k_cur, v_cur, m, l, acc = carry
-        src = jax.lax.rem(idx - i + n, n)
-        m, l, acc = block(q_scaled, k_cur, v_cur, src, m, l, acc)
-        perm = [(j, (j + 1) % n) for j in range(n)]
-        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        return k_nxt, v_nxt, m, l, acc
+        def body(i, carry):
+            k_cur, v_cur, m, l, acc = carry
+            src = jax.lax.rem(idx - i + n, n)
+            m, l, acc = block(q_, k_cur, v_cur, q_pos, src, m, l, acc)
+            perm = [(j, (j + 1) % n) for j in range(n)]
+            k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+            v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+            return k_nxt, v_nxt, m, l, acc
 
-    _, _, m, l, acc = jax.lax.fori_loop(0, n, body, (k, v, m0, l0, acc0))
-    l = jnp.maximum(jnp.moveaxis(l, 1, 2), 1e-30)   # [B, T, H, 1]
-    return (acc / l).astype(q.dtype)
+        _, _, m, l, acc = jax.lax.fori_loop(0, n, body, (k, v, m0, l0, acc0))
+        l = jnp.maximum(jnp.moveaxis(l, 1, 2), 1e-30)   # [B, Tq, H, 1]
+        return (acc / l).astype(q.dtype)
+
+    if not q_tile or T <= q_tile:
+        return ring(q_scaled, idx * T + t_local)
+    # long-context serving shapes: tile the query rows so the score
+    # block is [Tq, T_loc] instead of [T_loc, T_loc] — peak attention
+    # workspace is O(q_tile * T/n) per chip regardless of prompt length
+    # (each tile still rotates the full ring; KV transfers repeat per
+    # tile but stay overlapped with the block matmuls).  A non-aligned
+    # local length runs its remainder rows as one short extra ring so
+    # the memory bound holds for ANY bucket, not just tile multiples.
+    nt, T0 = T // q_tile, (T // q_tile) * q_tile
+    q_tiles = q_scaled[:, :T0].reshape(B, nt, q_tile, H, D).swapaxes(0, 1)
+    pos = (idx * T + t_local)[:T0].reshape(nt, q_tile)
+
+    def one(args):
+        qt, pt = args
+        return ring(qt, pt)
+
+    out = jax.lax.map(one, (q_tiles, pos))          # [nt, B, q_tile, H, D]
+    out = out.swapaxes(0, 1).reshape(B, T0, H, D)
+    if T0 < T:
+        rest = ring(q_scaled[:, T0:], (idx * T + t_local)[T0:])
+        out = jnp.concatenate([out, rest], axis=1)
+    return out
 
 
 def ring_attention(
@@ -85,14 +130,33 @@ def ring_attention(
     *,
     scale: float,
     causal: bool = True,
+    sliding_window: Optional[jax.Array] = None,
+    logit_softcap: Optional[float] = None,
+    head_axis: Optional[str] = None,
+    q_tile: int = 0,
 ) -> jax.Array:
-    """shard_map wrapper: exact attention over the sequence axis."""
-    fn = jax.shard_map(
-        functools.partial(_ring_local, axis_name=axis, scale=scale,
-                          causal=causal),
-        mesh=mesh,
-        in_specs=(P(None, axis), P(None, axis), P(None, axis)),
-        out_specs=P(None, axis),
-        check_vma=False,
-    )
+    """shard_map wrapper: exact attention over the sequence axis.
+
+    ``head_axis`` additionally shards the head dim (TP composition) —
+    only valid when it divides BOTH the query and KV head counts.
+    ``q_tile`` bounds the per-chip score-block workspace for long
+    sequences (0 = whole shard in one block)."""
+    if head_axis is not None:
+        tp = mesh.shape[head_axis]
+        if q.shape[2] % tp or k.shape[2] % tp:
+            raise ValueError(
+                f"head_axis={head_axis!r} (size {tp}) must divide query "
+                f"heads {q.shape[2]} and KV heads {k.shape[2]}")
+    spec = P(None, axis, head_axis)
+    local = functools.partial(_ring_local, axis_name=axis, scale=scale,
+                              causal=causal, logit_softcap=logit_softcap,
+                              q_tile=q_tile)
+    # a sliding window may be a TRACED per-layer scalar (scan flag), so
+    # it rides as an explicit replicated operand, never a closure capture
+    in_specs = (spec, spec, spec) + ((P(),) if sliding_window is not None
+                                    else ())
+    fn = jax.shard_map(local, mesh=mesh, in_specs=in_specs,
+                       out_specs=spec, check_vma=False)
+    if sliding_window is not None:
+        return fn(q, k, v, jnp.asarray(sliding_window))
     return fn(q, k, v)
