@@ -88,6 +88,8 @@ class Request:
     export_kv: bool = False                # prefill role: stage KV on finish
     kv_import: Optional[tuple] = None      # decode role: (meta, payload, first_token)
     kv_chunked: Optional[object] = None    # decode role: pd.ChunkedImport
+    kv_device: Optional[tuple] = None      # colocated decode role:
+    # (meta, (k_dev, v_dev), first_token) — device-to-device scatter
     submit_time: float = field(default_factory=time.monotonic)
     first_token_time: Optional[float] = None
     finish_time: Optional[float] = None
@@ -395,6 +397,7 @@ class InferenceEngine:
             "spec_steps_total": 0,
             "spec_proposed_tokens_total": 0,
             "spec_accepted_tokens_total": 0,
+            "pd_device_handoffs_total": 0,
         }
 
         self._decode_fn = self._build_decode_fn()
@@ -1060,6 +1063,40 @@ class InferenceEngine:
         self._wake.set()
         return req
 
+    def submit_with_kv_device(self, prompt_tokens: list[int],
+                              first_token: int, meta: dict, slabs,
+                              params: SamplingParams,
+                              req_id: Optional[str] = None) -> Request:
+        """Colocated decode entry: the prefill engine lives in THIS
+        process, so its staged canonical KV slab hands off as a single
+        device-to-device scatter — no host bounce, no wire (the
+        reference's NIXL device path,
+        preset_inferences.go:909-938, re-imagined for a shared slice).
+        ``slabs`` is ``StagedExport.device_slabs()``."""
+        self._validate_submit(prompt_tokens, params)
+        if meta.get("model") not in ("", None, self.md.name):
+            raise ValueError(f"KV transfer model mismatch: {meta.get('model')} "
+                             f"!= {self.md.name}")
+        # fail in the REQUEST thread, not the scheduler: a token count
+        # that disagrees with the staged slab would otherwise raise in
+        # _start_device_import on the engine loop (or, worse, decode
+        # silently against misaligned KV when the page counts happen
+        # to match)
+        if meta.get("n_tokens") not in (None, len(prompt_tokens)):
+            raise ValueError(
+                f"KV transfer token mismatch: client sent "
+                f"{len(prompt_tokens)} prompt tokens, staged slab holds "
+                f"{meta.get('n_tokens')}")
+        req = Request(req_id or f"pd-{self.counters['requests_total']}",
+                      list(prompt_tokens), params,
+                      kv_device=(meta, slabs, first_token))
+        with self._lock:
+            self.counters["requests_total"] += 1
+            self._waiting_count += 1
+            self.waiting.append(req)
+        self._wake.set()
+        return req
+
     def submit_with_kv_chunked(self, prompt_tokens: list[int],
                                first_token: int, meta: dict, plans,
                                params: SamplingParams,
@@ -1164,7 +1201,8 @@ class InferenceEngine:
             # adapter KV must never enter the shared tree (it embeds the
             # adapter's k/v deltas); imports are foreign bytes
             exclusive = (req.kv_import is not None
-                         or req.kv_chunked is not None or bool(req.adapter))
+                         or req.kv_chunked is not None
+                         or req.kv_device is not None or bool(req.adapter))
             tokens = [] if exclusive else req.resume_tokens()[:slot.written]
             if commit and not exclusive:
                 self.prefix_cache.release(tokens, slot.pages)
@@ -1354,7 +1392,7 @@ class InferenceEngine:
         n = len(tokens)
         cached = 0
         has_spill = (self.host_kv is not None and req.kv_import is None
-                     and req.kv_chunked is None
+                     and req.kv_chunked is None and req.kv_device is None
                      and self.host_kv.has(req.req_id))
         # leave one page of headroom per decoding slot so admissions
         # don't trigger immediate grow-preempt churn
@@ -1372,6 +1410,7 @@ class InferenceEngine:
             # inherit a cached prefix computed under different weights
             acquire_tokens = [] if (req.kv_import is not None
                                     or req.kv_chunked is not None
+                                    or req.kv_device is not None
                                     or has_spill or req.adapter) else tokens
             res = self.prefix_cache.acquire(acquire_tokens, n + 1)
             if res is None:
@@ -1435,6 +1474,9 @@ class InferenceEngine:
             if req.kv_import is not None:
                 self._start_imported(req, free_slot)
                 return True
+            if req.kv_device is not None:
+                self._start_device_import(req, free_slot)
+                return True
             if req.kv_chunked is not None:
                 self._start_chunked_import(req, free_slot)
                 return True
@@ -1458,6 +1500,29 @@ class InferenceEngine:
         slot = self.slots[free_slot]
         self.cache = import_kv(self.cache, slot.pages[:n_prompt_pages],
                                payload, meta)
+        if not req.prompt_counted:
+            self.counters["prompt_tokens_total"] += n
+            req.prompt_counted = True
+        self._begin_decode(free_slot, first, n)
+
+    def _start_device_import(self, req: Request, free_slot: int):
+        """Colocated decode start: ONE device-to-device scatter of the
+        prefill engine's staged canonical slab into this engine's
+        pages — the bytes never touch the host."""
+        from kaito_tpu.engine.pd import import_arrays
+
+        meta, (k_dev, v_dev), first = req.kv_device
+        n = len(req.prompt_tokens)
+        n_prompt_pages = -(-n // self.cfg.page_size)
+        slot = self.slots[free_slot]
+        self.cache = import_arrays(self.cache,
+                                   slot.pages[:n_prompt_pages],
+                                   k_dev, v_dev)
+        # drop the slab references (unpin HBM) but KEEP the field as a
+        # marker: _evict_slot reads it to keep imported pages out of
+        # the shared prefix tree, like the other import kinds
+        req.kv_device = (meta, None, first)
+        self.counters["pd_device_handoffs_total"] += 1
         if not req.prompt_counted:
             self.counters["prompt_tokens_total"] += n
             req.prompt_counted = True
@@ -1668,6 +1733,7 @@ class InferenceEngine:
         self._evict_slot(victim, commit=True)
         req.kv_import = None     # imported KV is consumed; resume recomputes
         req.kv_chunked = None
+        req.kv_device = None
         if not will_requeue:
             # the sequence already fills the whole pool: it cannot be
             # re-admitted (resume needs more pages than exist), and all
@@ -2136,11 +2202,15 @@ class InferenceEngine:
                 # whole request (pd.py design note)
                 n = len(req.prompt_tokens)
                 n_pages = -(-n // self.cfg.page_size)
+                # lazy_drain: the D2H copies start on the first HOST
+                # consumer (meta/chunk pull); a COLOCATED decode engine
+                # grabs the device slabs instead and the transfer never
+                # touches the host (the NIXL-device-path analogue)
                 self.kv_exports.put(req.req_id, stage_export(
                     self.cache, slot.pages[:n_pages], n_tokens=n,
                     model=self.md.name,
                     prompt_tokens=list(req.prompt_tokens),
-                    first_token=req.output_tokens[0]))
+                    first_token=req.output_tokens[0], lazy_drain=True))
             req.out.put(None)
             if self.host_kv is not None:
                 self.host_kv.discard(req.req_id)

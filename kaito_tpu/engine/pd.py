@@ -220,7 +220,8 @@ class StagedExport:
     is pinned only while the drain runs."""
 
     def __init__(self, k_dev, v_dev, meta: dict, plans: list[ChunkPlan],
-                 prompt_tokens: list[int], first_token: int):
+                 prompt_tokens: list[int], first_token: int,
+                 lazy_drain: bool = False):
         self.meta = meta
         self.plans = plans
         self.prompt_tokens = prompt_tokens
@@ -234,9 +235,33 @@ class StagedExport:
         self._lock = threading.Lock()
         self._blob_lock = threading.Lock()
         self._blob: Optional[bytes] = None
-        t = threading.Thread(target=self._drain, daemon=True,
-                             name="pd-export-copier")
-        t.start()
+        # lazy_drain defers the device→host copies until the first HOST
+        # consumer shows up (meta handshake / chunk pull): a COLOCATED
+        # decode engine then takes the device slabs directly and the
+        # bytes never touch the host at all
+        self._drain_lock = threading.Lock()
+        self._drain_started = False
+        if not lazy_drain:
+            self.ensure_draining()
+
+    def ensure_draining(self) -> None:
+        """Start the device→host copier once (idempotent)."""
+        with self._drain_lock:
+            if self._drain_started:
+                return
+            self._drain_started = True
+        threading.Thread(target=self._drain, daemon=True,
+                         name="pd-export-copier").start()
+
+    def device_slabs(self):
+        """The staged canonical device copies ``(k_dev, v_dev)`` for a
+        colocated device-to-device hand-off, or None once the drain has
+        released them.  The returned references stay valid even if the
+        drain finishes afterwards (the arrays are refcounted)."""
+        with self._drain_lock:
+            if self._k_dev is None:
+                return None
+            return self._k_dev, self._v_dev
 
     def _drain(self):
         try:
@@ -252,7 +277,8 @@ class StagedExport:
             for ev in self._ready:
                 ev.set()
         finally:
-            self._k_dev = self._v_dev = None   # unpin HBM
+            with self._drain_lock:
+                self._k_dev = self._v_dev = None   # unpin HBM
 
     @property
     def n_chunks(self) -> int:
@@ -265,6 +291,7 @@ class StagedExport:
         once), bounding staged host memory."""
         if not 0 <= i < len(self.plans):
             raise IndexError(f"chunk {i} out of range ({len(self.plans)})")
+        self.ensure_draining()
         if not self._ready[i].wait(timeout):
             raise TimeoutError(f"chunk {i} not ready after {timeout:.0f}s")
         if self._error:
@@ -278,12 +305,21 @@ class StagedExport:
                 self._served += 1
         return data
 
+    def restage_chunk(self, i: int, data: bytes) -> None:
+        """Put a consumed chunk back (a send failed after the claim) so
+        the receiver's retry finds it."""
+        with self._lock:
+            if self._chunks[i] is None:
+                self._chunks[i] = data
+                self._served -= 1
+
     @property
     def fully_served(self) -> bool:
         with self._lock:
             return self._served >= len(self.plans)
 
     def wait_all(self, timeout: float = 120.0) -> None:
+        self.ensure_draining()
         deadline = time.monotonic() + timeout
         for ev in self._ready:
             if not ev.wait(max(0.0, deadline - time.monotonic())):
@@ -317,7 +353,7 @@ class StagedExport:
 
 def stage_export(cache: KVCache, pages: list[int], *, n_tokens: int,
                  model: str, prompt_tokens: list[int],
-                 first_token: int) -> StagedExport:
+                 first_token: int, lazy_drain: bool = False) -> StagedExport:
     """Engine-thread entry: on-device gather + chunk plan; returns the
     staged export whose copier is already draining.
 
@@ -334,7 +370,7 @@ def stage_export(cache: KVCache, pages: list[int], *, n_tokens: int,
             "dtype": str(k_dev.dtype), "n_tokens": n_tokens,
             "model": model, "chunks": [p.to_json() for p in plans]}
     return StagedExport(k_dev, v_dev, meta, plans, prompt_tokens,
-                        first_token)
+                        first_token, lazy_drain=lazy_drain)
 
 
 class KVExportRegistry:
@@ -381,8 +417,13 @@ class KVExportRegistry:
             del self._items[k]
 
     def __len__(self) -> int:
+        """Live (not-yet-exhausted) entries.  A fully-served export is
+        logically gone the moment its last chunk is claimed — physical
+        removal may lag by one handler turn (the endpoint drops it
+        after the final write), so counting it would race observers."""
         with self._lock:
-            return len(self._items)
+            return sum(1 for e in self._items.values()
+                       if not e.fully_served)
 
 
 # ---------------------------------------------------------------------------
@@ -569,8 +610,24 @@ def bench_kv_handoff(model_name: str, ctxs, on_tpu: bool) -> dict:
         out[f"pd_handoff_ms@{ctx}"] = round(ms, 1)
         out[f"pd_handoff_mb_s@{ctx}"] = round(total_mb / max(
             t_export + t_import, 1e-9), 1)
+        # colocated device-to-device path (no host bounce): gather +
+        # one scatter, both on device — what a shared-slice/single-host
+        # MRI hand-off costs vs the host-staged wire above
+        for warm in (True, False):
+            dest2 = create_kv_cache(arch, n_pages + 1, page_size, dtype)
+            t2 = time.monotonic()
+            staged_d = stage_export(cache, pages, n_tokens=ctx,
+                                    model=model_name, prompt_tokens=[],
+                                    first_token=0, lazy_drain=True)
+            k_dev, v_dev = staged_d.device_slabs()
+            dest2 = import_arrays(dest2, pages, k_dev, v_dev)
+            jax.block_until_ready((dest2.k, dest2.v))
+            t_device = time.monotonic() - t2
+        out[f"pd_device_handoff_ms@{ctx}"] = round(t_device * 1e3, 1)
+        out[f"pd_device_mb_s@{ctx}"] = round(
+            total_mb / max(t_device, 1e-9), 1)
         cost = transfer_cost(ctx, arch, np.dtype(dtype).itemsize)
         out[f"pd_breakeven_transfer@{ctx}"] = bool(
             cost["transfer_s"] < cost["recompute_s"])
-        del cache, dest, staged
+        del cache, dest, dest2, staged, staged_d
     return out

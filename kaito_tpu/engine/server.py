@@ -327,6 +327,9 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         exp = self.state.engine.kv_exports.get(req_id)
         if exp is None:
             return self._error(404, f"no staged KV for {req_id}")
+        # a remote puller is here: start the (lazy) D2H drain now so
+        # the chunk pulls overlap the remaining copies
+        exp.ensure_draining()
         self._json(200, {"meta": exp.meta, "n_chunks": exp.n_chunks})
 
     def _pd_kv_chunk(self, req_id: str, idx: str):
@@ -341,24 +344,29 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         if exp is None:
             return self._error(404, f"no staged KV for {req_id}")
         try:
-            # read WITHOUT consuming: a connection that drops mid-write
-            # must leave the chunk staged for the puller's retry
-            data = exp.get_chunk(int(idx), consume=False)
+            # consume is the atomic claim (a duplicate pull gets a clean
+            # 410); a write that fails re-stages the chunk so the
+            # puller's retry still finds it
+            data = exp.get_chunk(int(idx))
         except (IndexError, ValueError) as e:
             return self._error(400, str(e))
         except KeyError as e:
             return self._error(410, str(e))
         except Exception as e:
             return self._error(500, f"chunk read failed: {e}")
-        self.send_response(200)
-        self.send_header("Content-Type", "application/octet-stream")
-        self.send_header("Content-Length", str(len(data)))
-        self.end_headers()
-        self.wfile.write(data)
-        try:   # bytes are on the wire: consume, drop entry when drained
-            exp.get_chunk(int(idx))
-        except KeyError:
-            pass   # a duplicate pull raced us; consumed either way
+        try:
+            self.send_response(200)
+            self.send_header("Content-Type", "application/octet-stream")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+        except OSError:
+            # client vanished mid-write: un-consume for the retry, and
+            # re-put in case a concurrent observer saw fully_served and
+            # dropped the registry entry while the write was in flight
+            exp.restage_chunk(int(idx), data)
+            reg.put(req_id, exp)
+            raise
         reg.drop_served(req_id)
 
     def _submit_with_transfer(self, kv_src: dict, params):
@@ -391,6 +399,40 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         prompt_tokens = kv_src.get("prompt_tokens") or []
         first = int(kv_src.get("first_token", 0))
         eng = self.state.engine
+        # colocated source => device-to-device hand-off (no host, no
+        # wire, and trivially above any break-even); "wire": "http"
+        # forces the chunked path (tests / operator override)
+        if kv_src.get("wire", "auto") != "http":
+            src_eng = lookup_local_engine(url)
+            if src_eng is not None:
+                staged = src_eng.kv_exports.pop(req_id)
+                if staged is not None:
+                    # the prefill engine staged the true token list; a
+                    # client claiming different tokens must not scatter
+                    # this slab under them
+                    if (staged.prompt_tokens
+                            and list(prompt_tokens) != staged.prompt_tokens):
+                        src_eng.kv_exports.put(req_id, staged)
+                        self._error(400, "kv_transfer prompt_tokens do not "
+                                         "match the staged prefill")
+                        return None
+                    slabs = staged.device_slabs()
+                    if slabs is not None:
+                        logger.info("kv_transfer %s: colocated source, "
+                                    "device-to-device hand-off", req_id)
+                        try:
+                            return eng.submit_with_kv_device(
+                                prompt_tokens, first, staged.meta, slabs,
+                                params,
+                                req_id=f"cmpl-{uuid.uuid4().hex[:20]}")
+                        except ValueError:
+                            # a rejected submit must not destroy the
+                            # prefill result: re-stage for retry/wire
+                            src_eng.kv_exports.put(req_id, staged)
+                            raise
+                    # a remote drain already released the slabs: put it
+                    # back and fall through to the wire path
+                    src_eng.kv_exports.put(req_id, staged)
         cache = getattr(eng, "cache", None)
         kv_itemsize = cache.k.dtype.itemsize if cache is not None else 2
         # the recompute fallback re-samples the first token locally, so
@@ -764,13 +806,59 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         self._json(200, resp)
 
 
+# Colocated P/D: engines served from THIS process, keyed by base URL.
+# When a kv_transfer's source_url resolves here, the hand-off is a
+# device-to-device copy of the staged slab — no host bounce, no wire
+# (the single-host MRI / shared-slice case of the reference's NIXL
+# device path, preset_inferences.go:909-938).
+_LOCAL_PD_ENGINES: dict[str, InferenceEngine] = {}
+_LOCAL_PD_LOCK = threading.Lock()
+
+
+def lookup_local_engine(url: str) -> Optional[InferenceEngine]:
+    with _LOCAL_PD_LOCK:
+        return _LOCAL_PD_ENGINES.get(url.rstrip("/"))
+
+
+class _PDServer(ThreadingHTTPServer):
+    """HTTP server that registers its engine for colocated P/D and
+    unregisters when it stops serving (shutdown or close) — ports get
+    reused across tests, and a stale entry would pin the engine's KV
+    cache and divert future colocated lookups to a dead engine."""
+
+    _pd_urls: tuple[str, ...] = ()
+
+    def _pd_unregister(self):
+        with _LOCAL_PD_LOCK:
+            for u in self._pd_urls:
+                if _LOCAL_PD_ENGINES.get(u) is self.state.engine:
+                    del _LOCAL_PD_ENGINES[u]
+
+    def shutdown(self):
+        self._pd_unregister()
+        super().shutdown()
+
+    def server_close(self):
+        self._pd_unregister()
+        super().server_close()
+
+
 def make_server(engine: InferenceEngine, cfg: EngineConfig,
                 host: str = "0.0.0.0", port: Optional[int] = None) -> ThreadingHTTPServer:
     state = ServerState(engine, cfg)
     handler = type("Handler", (OpenAIHandler,), {"state": state})
-    server = ThreadingHTTPServer((host, port if port is not None else cfg.port),
-                                 handler)
+    server = _PDServer((host, port if port is not None else cfg.port),
+                       handler)
     server.state = state  # type: ignore[attr-defined]
+    bound = server.server_address[1]
+    hosts = {"127.0.0.1", "localhost"}
+    if host not in ("0.0.0.0", "::", ""):
+        hosts.add(host)
+    urls = tuple(f"http://{h}:{bound}" for h in sorted(hosts))
+    server._pd_urls = urls
+    with _LOCAL_PD_LOCK:
+        for u in urls:
+            _LOCAL_PD_ENGINES[u] = engine
     return server
 
 

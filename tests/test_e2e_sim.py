@@ -162,8 +162,9 @@ def test_tuning_workspace_to_adapter(tmp_path):
 def test_pd_mri_to_tokens():
     """P/D e2e-sim: a MultiRoleInference CR renders prefill/decode role
     workloads whose PD env is then BOOTED as two live engine servers;
-    a forced chunked KV transfer between them matches the monolithic
-    greedy output."""
+    the KV transfer between them matches the monolithic greedy output.
+    Both engines share this process (the single-host MRI shape), so the
+    hand-off takes the device-to-device path — asserted below."""
     from kaito_tpu.api import MultiRoleInference
     from kaito_tpu.api.multiroleinference import (
         MRIModelSpec,
@@ -237,6 +238,9 @@ def test_pd_mri_to_tokens():
                             "first_token": pre["first_token"],
                             "force": True}})
         assert out["choices"][0]["text"] == mono["choices"][0]["text"]
+        # colocated roles: the transfer rode the device path, no host
+        # bounce (the cross-pod case pins "wire": "http" instead)
+        assert dec_eng.counters["pd_device_handoffs_total"] == 1
     finally:
         pre_srv.shutdown()
         dec_srv.shutdown()

@@ -58,14 +58,16 @@ def test_pd_handoff_matches_monolithic(pd_pair):
     assert pre["n_tokens"] > 0
     assert len(prefill_engine.kv_exports) == 1
 
-    # 2) decode pod pulls the KV (chunked path, forced past the
-    # break-even model — this prompt is far below it) and continues
+    # 2) decode pod pulls the KV (chunked WIRE path: both engines live
+    # in this test process, so "wire": "http" pins the path the test
+    # covers; forced past the break-even model — this prompt is far
+    # below it) and continues
     out = _post(decode_url, "/v1/completions", {
         "prompt": prompt, "max_tokens": 8, "temperature": 0.0,
         "kv_transfer": {"source_url": prefill_url, "req_id": pre["req_id"],
                         "prompt_tokens": pre["prompt_tokens"],
                         "first_token": pre["first_token"],
-                        "force": True}})
+                        "force": True, "wire": "http"}})
     text = out["choices"][0]["text"]
     assert text == mono_text
     # staged KV is consumed (every chunk served -> entry dropped)
@@ -87,9 +89,16 @@ def test_pd_breakeven_recompute_fallback(pd_pair):
         "prompt": prompt, "max_tokens": 6, "temperature": 0.0,
         "kv_transfer": {"source_url": prefill_url, "req_id": pre["req_id"],
                         "prompt_tokens": pre["prompt_tokens"],
-                        "first_token": pre["first_token"]}})
+                        "first_token": pre["first_token"],
+                        "wire": "http"}})
     assert out["choices"][0]["text"] == mono["choices"][0]["text"]
-    # DELETE released the staged export without a pull
+    # DELETE released the staged export without a pull (fired from a
+    # daemon thread off the request path, so poll briefly)
+    import time as _time
+    for _ in range(100):
+        if len(prefill_engine.kv_exports) == 0:
+            break
+        _time.sleep(0.05)
     assert len(prefill_engine.kv_exports) == 0
 
 
@@ -152,6 +161,59 @@ def test_pd_chunked_token_parity():
     finally:
         cons.stop()
         prod.stop()
+
+
+def test_pd_device_handoff_colocated(pd_pair):
+    """Colocated engines (same process, as in single-host MRI) hand off
+    KV device-to-device: no drain to host, no wire — and the greedy
+    continuation still matches the monolithic engine exactly."""
+    prefill_url, decode_url, prefill_engine, decode_engine = pd_pair
+    prompt = "device direct handoff"
+    mono = _post(decode_url, "/v1/completions", {
+        "prompt": prompt, "max_tokens": 8, "temperature": 0.0})
+    pre = _post(prefill_url, "/pd/prefill", {"prompt": prompt,
+                                             "temperature": 0.0})
+    staged = prefill_engine.kv_exports.get(pre["req_id"])
+    assert staged is not None and not staged._drain_started
+    before = decode_engine.counters["pd_device_handoffs_total"]
+    out = _post(decode_url, "/v1/completions", {
+        "prompt": prompt, "max_tokens": 8, "temperature": 0.0,
+        "kv_transfer": {"source_url": prefill_url, "req_id": pre["req_id"],
+                        "prompt_tokens": pre["prompt_tokens"],
+                        "first_token": pre["first_token"]}})
+    assert out["choices"][0]["text"] == mono["choices"][0]["text"]
+    assert decode_engine.counters["pd_device_handoffs_total"] == before + 1
+    # the export was claimed by the device path and never drained
+    assert prefill_engine.kv_exports.get(pre["req_id"]) is None
+    assert not staged._drain_started
+
+
+def test_pd_device_handoff_mla():
+    """The device path carries MLA's zero-size V without any wire
+    format at all: stage on one engine, scatter into another."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kaito_tpu.engine.kv_cache import KVCache, create_kv_cache
+    from kaito_tpu.engine.pd import import_arrays, stage_export
+    from kaito_tpu.models.autogen import arch_from_hf_config
+    from tests.test_mla import MLA_CFG
+
+    arch = arch_from_hf_config(MLA_CFG)
+    cache = create_kv_cache(arch, 8, 16, jnp.float32)
+    rng = np.random.default_rng(1)
+    cache = KVCache(k=jnp.asarray(rng.normal(size=cache.k.shape),
+                                  jnp.float32), v=cache.v)
+    pages = [2, 5]
+    staged = stage_export(cache, pages, n_tokens=30, model="mla",
+                          prompt_tokens=[], first_token=0,
+                          lazy_drain=True)
+    k_dev, v_dev = staged.device_slabs()
+    dest = import_arrays(create_kv_cache(arch, 8, 16, jnp.float32),
+                         pages, k_dev, v_dev)
+    np.testing.assert_array_equal(np.asarray(dest.k[:, pages]),
+                                  np.asarray(cache.k[:, pages]))
+    assert not staged._drain_started
 
 
 def test_pd_chunk_endpoints(pd_pair):
