@@ -151,6 +151,8 @@ class _Slot:
     importing: bool = False    # PD decode role: KV chunks still landing
     prefill_pos: int = 0       # prompt tokens written so far (incl. cached)
     prefill_tokens: list[int] = field(default_factory=list)
+    prefill_t0: float = 0.0    # first-chunk dispatch time (cost model)
+    prefill_base: int = 0      # prefill_pos at first dispatch (cached skip)
     seq: int = 0               # admission order (newest preempts first)
 
     @property
@@ -413,9 +415,13 @@ class InferenceEngine:
         self.run_ahead = max(1, int(ra))
         self._decode_multi_fns: dict[int, object] = {}
 
-        from kaito_tpu.engine.pd import KVExportRegistry
+        from kaito_tpu.engine.pd import KVExportRegistry, TransferCostModel
 
         self.kv_exports = KVExportRegistry()
+        # live-calibrated transfer-vs-recompute constants: observed
+        # prefill throughput + observed import bandwidth feed the
+        # break-even decision (static knobs are cold-start priors only)
+        self.pd_costs = TransferCostModel()
 
     # ------------------------------------------------------------------
     # Construction helpers
@@ -1225,6 +1231,8 @@ class InferenceEngine:
         slot.importing = False
         slot.prefill_tokens = []
         slot.prefill_pos = 0
+        slot.prefill_t0 = 0.0
+        slot.prefill_base = 0
         slot.position = 0
         slot.remaining = 0
         self.slot_adapters[slot_idx] = 0
@@ -1564,6 +1572,12 @@ class InferenceEngine:
                         self.cache = import_arrays(
                             self.cache, slot.pages[:n_pages], k, v)
                         slot.importing = False
+                        # a completed transfer calibrates the link side
+                        # of the break-even model with the observed
+                        # end-to-end wire bandwidth
+                        if ci.t0 is not None:
+                            self.pd_costs.note_transfer(
+                                ci.bytes_fed, time.monotonic() - ci.t0)
                         self._begin_decode(i, ci.first_token, n)
                         did = True
                 except Exception as e:
@@ -1606,6 +1620,7 @@ class InferenceEngine:
         ctoks = np.zeros((1, bucket), np.int32)
         ctoks[0, :m] = chunk
         aid = jnp.asarray(self.slot_adapters[i:i + 1])
+        t_first_chunk = time.monotonic()
         try:
             if use_cp:
                 fn = self._prefill_cp_fn(bucket)
@@ -1639,6 +1654,9 @@ class InferenceEngine:
             self._recover_cache_if_poisoned()
             return True
         self.counters["prefill_steps_total"] += 1
+        if not slot.prefill_t0:
+            slot.prefill_t0 = t_first_chunk
+            slot.prefill_base = pos
         slot.prefill_pos = pos + m
         if slot.prefill_pos >= n:
             if not req.prompt_counted:
@@ -1648,6 +1666,13 @@ class InferenceEngine:
                 req.prompt_counted = True
             slot.prefilling = False
             first, first_lp = self._sample_first(i, logits)
+            # _sample_first blocked on the logits, so the elapsed time
+            # covers real compute (plus scheduler interleaving — the
+            # honest opportunity cost a transfer would avoid)
+            if slot.prefill_t0:
+                self.pd_costs.note_prefill(
+                    n - slot.prefill_base,
+                    time.monotonic() - slot.prefill_t0)
             self._begin_decode(i, first, n, first_lp=first_lp)
         return True
 

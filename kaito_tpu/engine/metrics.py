@@ -186,6 +186,22 @@ class EngineMetrics:
                   "Bytes held by the host KV offload tier", r,
                   fn=lambda: engine.host_kv.used_bytes
                   if engine.host_kv else 0)
+            Gauge("kaito:pd_device_handoffs_total",
+                  "Colocated device-to-device KV hand-offs", r,
+                  fn=lambda: engine.counters.get(
+                      "pd_device_handoffs_total", 0))
+            # live-calibrated break-even constants (0 until the first
+            # observed transfer / prefill provides a sample)
+            Gauge("kaito:pd_measured_net_bytes_s",
+                  "EWMA observed KV transfer bandwidth", r,
+                  fn=lambda: (getattr(engine, "pd_costs", None)
+                              and engine.pd_costs.snapshot()
+                              .get("net_bytes_s") or 0))
+            Gauge("kaito:pd_measured_prefill_tok_s",
+                  "EWMA observed prefill throughput", r,
+                  fn=lambda: (getattr(engine, "pd_costs", None)
+                              and engine.pd_costs.snapshot()
+                              .get("prefill_tok_s") or 0))
 
     def observe_request(self, req) -> None:
         if req.first_token_time:

@@ -454,6 +454,11 @@ class ChunkedImport:
         self.n_scattered = 0          # chunks assembled into host buffers
         self._pending: list[tuple[int, bytes]] = []
         self._n_fed = 0
+        self.bytes_fed = 0            # wire bytes received (cost model)
+        # bandwidth clock starts at the FIRST chunk arrival, not at
+        # admission: slot-queue wait must not be charged to the link
+        # (the prefill clock likewise starts at first dispatch)
+        self.t0: Optional[float] = None
         self._last_fed = time.monotonic()
         self._error: Optional[str] = None
         self._lock = threading.Lock()
@@ -471,7 +476,10 @@ class ChunkedImport:
         with self._lock:
             self._pending.append((idx, payload))
             self._n_fed += 1
+            self.bytes_fed += len(payload)
             self._last_fed = time.monotonic()
+            if self.t0 is None:
+                self.t0 = self._last_fed
 
     def set_error(self, msg: str) -> None:
         with self._lock:
@@ -523,6 +531,52 @@ class ChunkedImport:
 # transfer-vs-recompute break-even
 # ---------------------------------------------------------------------------
 
+class TransferCostModel:
+    """Live-calibrated constants for the break-even decision.
+
+    The static knobs in :func:`transfer_cost` are order-of-magnitude
+    priors; this model replaces them with EWMA self-measurements as the
+    engine observes REAL work: completed chunked KV imports calibrate
+    the effective link bandwidth, completed prefills calibrate the
+    recompute rate (including scheduler interleaving — the true
+    opportunity cost of a local prefill).  Until a side has a sample,
+    the static prior for that side stays in effect, so cold-start
+    behavior is unchanged."""
+
+    def __init__(self, alpha: float = 0.3):
+        self.alpha = alpha
+        self._lock = threading.Lock()
+        self.net_bytes_s: Optional[float] = None
+        self.prefill_tok_s: Optional[float] = None
+        self.transfer_samples = 0
+        self.prefill_samples = 0
+
+    def _ewma(self, cur: Optional[float], x: float) -> float:
+        return x if cur is None else (1 - self.alpha) * cur + self.alpha * x
+
+    def note_transfer(self, nbytes: int, seconds: float) -> None:
+        if nbytes <= 0 or seconds <= 1e-6:
+            return
+        with self._lock:
+            self.net_bytes_s = self._ewma(self.net_bytes_s,
+                                          nbytes / seconds)
+            self.transfer_samples += 1
+
+    def note_prefill(self, tokens: int, seconds: float) -> None:
+        if tokens <= 0 or seconds <= 1e-6:
+            return
+        with self._lock:
+            self.prefill_tok_s = self._ewma(self.prefill_tok_s,
+                                            tokens / seconds)
+            self.prefill_samples += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"net_bytes_s": self.net_bytes_s,
+                    "prefill_tok_s": self.prefill_tok_s,
+                    "transfer_samples": self.transfer_samples,
+                    "prefill_samples": self.prefill_samples}
+
 def estimate_params(arch) -> int:
     """Approximate parameter count from the architecture dims (embed +
     per-layer attn/mlp), enough for a FLOPs estimate."""
@@ -537,20 +591,31 @@ def estimate_params(arch) -> int:
 
 def transfer_cost(n_tokens: int, arch, dtype_bytes: int = 2, *,
                   net_bytes_s: float = 2.5e9, chip_flops: float = 1.97e14,
-                  mfu: float = 0.35) -> dict:
+                  mfu: float = 0.35,
+                  measured: Optional[TransferCostModel] = None) -> dict:
     """Estimate KV-transfer time vs local prefill recompute time.
 
-    Defaults: ~20 Gb/s effective pod-to-pod DCN, v5e bf16 peak with a
-    conservative prefill MFU.  Both are order-of-magnitude knobs — the
-    decision only needs the right side of a ~100× separation (a 128-tok
-    prompt recomputes in <1 ms but transfers in ~10 ms; an 8k prompt on
-    a 70B flips hard the other way)."""
+    Static defaults: ~20 Gb/s effective pod-to-pod DCN, v5e bf16 peak
+    with a conservative prefill MFU — order-of-magnitude PRIORS only
+    used when ``measured`` has no sample for that side.  Once the
+    engine has observed real transfers/prefills, the measured EWMA
+    rates drive the decision (mid-range prompts on a fast link sit
+    near the boundary, where a 4x prior error flips it the wrong
+    way)."""
     kv_bytes = (2 * arch.num_layers * n_tokens * arch.num_kv_heads
                 * arch.head_dim * dtype_bytes)
-    transfer_s = kv_bytes / net_bytes_s
-    recompute_s = 2.0 * estimate_params(arch) * n_tokens / (chip_flops * mfu)
+    m = measured.snapshot() if measured is not None else {}
+    net = m.get("net_bytes_s") or net_bytes_s
+    transfer_s = kv_bytes / net
+    if m.get("prefill_tok_s"):
+        recompute_s = n_tokens / m["prefill_tok_s"]
+    else:
+        recompute_s = (2.0 * estimate_params(arch) * n_tokens
+                       / (chip_flops * mfu))
     return {"kv_bytes": kv_bytes, "transfer_s": transfer_s,
-            "recompute_s": recompute_s}
+            "recompute_s": recompute_s,
+            "calibrated": bool(m.get("net_bytes_s")
+                               or m.get("prefill_tok_s"))}
 
 
 def should_transfer(n_tokens: int, arch, dtype_bytes: int = 2, **kw) -> bool:

@@ -441,7 +441,8 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         # transfer path
         if (not kv_src.get("force") and params.temperature == 0.0
                 and not should_transfer(
-                    len(prompt_tokens), eng.md.arch, kv_itemsize)):
+                    len(prompt_tokens), eng.md.arch, kv_itemsize,
+                    measured=getattr(eng, "pd_costs", None))):
             # below break-even: local prefill beats the wire.  Release
             # the staged export so the prefill pod doesn't hold it to
             # TTL, then admit as a plain request (greedy output is

@@ -1,0 +1,188 @@
+"""Data-parallel HTTP front: round-robin across N engine backends.
+
+The in-miniature data plane of the repo's replica tier: in production,
+InferenceSet replicas sit behind the rendered Service/InferencePool and
+the GAIE EPP picks endpoints (``controllers/inferenceset.py``); the
+reference's analogue is vLLM ``--data-parallel-size`` over Ray plus its
+routing sidecar (``preset_inferences.go:909-985``).  This router is the
+same contract as ONE process you can boot in tests, dryruns, and
+single-node deployments: each backend is a fully independent engine
+server (its own process, its own devices), and requests — including
+SSE streams — relay byte-for-byte.
+
+Scheduling is round-robin with health-aware skip: a backend that
+refuses the connection is marked down and retried on a cool-down, so a
+dead replica costs one skipped turn, not a failed request (behavior the
+dp-over-2-procs test pins).
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+DOWN_COOLDOWN_S = 5.0
+HOP_HEADERS = {"connection", "keep-alive", "transfer-encoding",
+               "te", "trailer", "upgrade", "proxy-authorization"}
+
+
+class _Backend:
+    def __init__(self, url: str):
+        url = url.rstrip("/")
+        assert url.startswith("http://"), f"http backends only: {url}"
+        self.url = url
+        hostport = url[len("http://"):]
+        self.host, _, port = hostport.partition(":")
+        self.port = int(port or 80)
+        self.down_until = 0.0
+        self.served = 0
+
+    @property
+    def alive(self) -> bool:
+        return time.monotonic() >= self.down_until
+
+    def mark_down(self) -> None:
+        self.down_until = time.monotonic() + DOWN_COOLDOWN_S
+
+
+class DPRouter:
+    """Round-robin chooser over backends, shared by handler threads."""
+
+    def __init__(self, backends: list[str]):
+        if not backends:
+            raise ValueError("dp router needs at least one backend")
+        self.backends = [_Backend(u) for u in backends]
+        self._rr = 0
+        self._lock = threading.Lock()
+
+    def next_backend(self) -> Optional[_Backend]:
+        """Next live backend (round robin), or the next one regardless
+        if every backend is cooling down (better a refused retry than a
+        guaranteed 503 when all marks are stale)."""
+        with self._lock:
+            n = len(self.backends)
+            for offset in range(n):
+                b = self.backends[(self._rr + offset) % n]
+                if b.alive:
+                    self._rr = (self._rr + offset + 1) % n
+                    b.served += 1
+                    return b
+            b = self.backends[self._rr % n]
+            self._rr = (self._rr + 1) % n
+            b.served += 1
+            return b
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {b.url: {"served": b.served, "alive": b.alive}
+                    for b in self.backends}
+
+
+def make_router_server(router: DPRouter, host: str = "0.0.0.0",
+                       port: int = 0) -> ThreadingHTTPServer:
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def _relay(self, method: str):
+            if self.path == "/router/stats":
+                body = json.dumps(router.stats()).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            length = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(length) if length else None
+            tried = 0
+            while tried < len(router.backends):
+                b = router.next_backend()
+                tried += 1
+                try:
+                    self._forward(b, method, body)
+                    return
+                except (ConnectionError, OSError) as e:
+                    logger.warning("backend %s unreachable (%s); skipping",
+                                   b.url, e)
+                    b.mark_down()
+            self.send_response(503)
+            msg = b'{"error": "no live backend"}'
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(msg)))
+            self.end_headers()
+            self.wfile.write(msg)
+
+        def _forward(self, b: _Backend, method: str,
+                     body: Optional[bytes]) -> None:
+            conn = http.client.HTTPConnection(b.host, b.port, timeout=600)
+            headers = {k: v for k, v in self.headers.items()
+                       if k.lower() not in HOP_HEADERS}
+            conn.request(method, self.path, body=body, headers=headers)
+            resp = conn.getresponse()
+            self.send_response(resp.status)
+            chunked = False
+            for k, v in resp.getheaders():
+                if k.lower() in HOP_HEADERS:
+                    chunked = chunked or (k.lower() == "transfer-encoding"
+                                          and "chunked" in v.lower())
+                    continue
+                self.send_header(k, v)
+            has_len = resp.getheader("Content-Length") is not None
+            if not has_len:
+                # stream of unknown length (SSE): relay chunked
+                self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            # relay bytes AS THEY ARRIVE so SSE tokens stream through
+            while True:
+                chunk = resp.read1(65536) if hasattr(resp, "read1") \
+                    else resp.read(65536)
+                if not chunk:
+                    break
+                if has_len:
+                    self.wfile.write(chunk)
+                else:
+                    self.wfile.write(b"%x\r\n%s\r\n" % (len(chunk), chunk))
+                self.wfile.flush()
+            if not has_len:
+                self.wfile.write(b"0\r\n\r\n")
+            conn.close()
+
+        def do_GET(self):
+            self._relay("GET")
+
+        def do_POST(self):
+            self._relay("POST")
+
+        def do_DELETE(self):
+            self._relay("DELETE")
+
+    return ThreadingHTTPServer((host, port), Handler)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="kaito-tpu-dp-router")
+    ap.add_argument("--backend", action="append", required=True,
+                    help="backend base URL (repeat per replica)")
+    ap.add_argument("--port", type=int, default=5000)
+    ap.add_argument("--host", default="0.0.0.0")
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    srv = make_router_server(DPRouter(args.backend), args.host, args.port)
+    logger.info("dp router on :%d -> %s", srv.server_address[1],
+                args.backend)
+    srv.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

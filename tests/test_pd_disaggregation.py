@@ -158,6 +158,11 @@ def test_pd_chunked_token_parity():
         list(req.stream())
         assert req.finish_reason != "error"
         assert list(req.output_tokens) == ref_out
+        # the completed transfer calibrated the link side of the
+        # break-even model
+        snap = cons.pd_costs.snapshot()
+        assert snap["transfer_samples"] >= 1
+        assert snap["net_bytes_s"] > 0
     finally:
         cons.stop()
         prod.stop()
@@ -261,6 +266,55 @@ def test_pd_decode_rejects_bad_source(pd_pair):
                             "first_token": 0, "force": True}})
     assert e.value.code == 502
 
+
+
+def test_pd_breakeven_calibration():
+    """Measured rates OVERRIDE the static priors in the break-even
+    decision: feeding opposite extreme measurements flips it both
+    ways, and an empty model reproduces the priors exactly."""
+    from kaito_tpu.engine.pd import (TransferCostModel, should_transfer,
+                                     transfer_cost)
+    from kaito_tpu.models import get_model_by_name
+
+    arch = get_model_by_name("tiny-llama-test").arch
+    n = 1024
+    # dead-slow measured link + instant local prefill -> never transfer
+    slow = TransferCostModel()
+    slow.note_transfer(1024, 10.0)        # ~100 B/s
+    slow.note_prefill(100000, 0.001)      # 100M tok/s
+    assert should_transfer(n, arch, 4, measured=slow) is False
+    # near-infinite measured link + 1 tok/s local prefill -> transfer
+    fast = TransferCostModel()
+    fast.note_transfer(10**9, 0.001)      # ~1 TB/s
+    fast.note_prefill(10, 10.0)           # 1 tok/s
+    assert should_transfer(n, arch, 4, measured=fast) is True
+    # no samples: the static priors apply unchanged
+    c1 = transfer_cost(n, arch, 4)
+    c2 = transfer_cost(n, arch, 4, measured=TransferCostModel())
+    assert c1["transfer_s"] == c2["transfer_s"]
+    assert c1["recompute_s"] == c2["recompute_s"]
+    assert not c2["calibrated"] and not c1["calibrated"]
+    # EWMA folds successive samples
+    m = TransferCostModel(alpha=0.5)
+    m.note_transfer(100, 1.0)
+    m.note_transfer(300, 1.0)
+    assert m.snapshot()["net_bytes_s"] == 200.0
+
+
+def test_pd_cost_model_self_calibrates():
+    """A plain completion leaves a prefill-throughput sample behind."""
+    eng = InferenceEngine(EngineConfig(**CFG))
+    eng.start()
+    try:
+        out = list(eng.submit(list(range(2, 30)),
+                              SamplingParams(max_tokens=2, temperature=0.0,
+                                             ignore_eos=True)).stream())
+        assert len(out) == 2
+        snap = eng.pd_costs.snapshot()
+        assert snap["prefill_samples"] >= 1
+        assert snap["prefill_tok_s"] > 0
+    finally:
+        eng.stop()
 
 
 def test_pd_mla_roundtrip():
