@@ -17,7 +17,8 @@ def _llama3(messages) -> str:
     out = ["<|begin_of_text|>"]
     for m in messages:
         out.append(f"<|start_header_id|>{m.get('role', 'user')}"
-                   f"<|end_header_id|>\n\n{m.get('content', '')}<|eot_id|>")
+                   f"<|end_header_id|>\n\n"
+                   f"{m.get('content', '').strip()}<|eot_id|>")
     out.append("<|start_header_id|>assistant<|end_header_id|>\n\n")
     return "".join(out)
 
@@ -40,46 +41,90 @@ def _gemma(messages) -> str:
     return "".join(out)
 
 
-def _phi(messages) -> str:
+def _phi3(messages) -> str:
+    """phi-3 / phi-3.5 (reference phi-3.jinja): ``<|role|>`` turns, no
+    BOS, content trimmed."""
     out = []
     for m in messages:
-        out.append(f"<|{m.get('role', 'user')}|>\n{m.get('content', '')}<|end|>\n")
+        out.append(f"<|{m.get('role', 'user')}|>\n"
+                   f"{m.get('content', '').strip()}<|end|>\n")
     out.append("<|assistant|>\n")
     return "".join(out)
 
 
-def _mistral(messages) -> str:
-    out = ["<s>"]
-    system = ""
+def _phi3_small(messages) -> str:
+    """phi-3-small (reference phi-3-small.jinja): the phi-3 body with a
+    leading BOS (phi-3-small's tokenizer BOS is ``<|endoftext|>``)."""
+    return "<|endoftext|>" + _phi3(messages)
+
+
+def _phi4(messages) -> str:
+    """phi-4 / phi-4-mini (reference phi-4.jinja +
+    tool-chat-phi4-mini.jinja): ChatML-with-``<|im_sep|>`` turns — NOT
+    the phi-3 shape; the two families diverged at phi-4."""
+    out = []
     for m in messages:
-        role, content = m.get("role"), m.get("content", "")
+        out.append(f"<|im_start|>{m.get('role', 'user')}<|im_sep|>"
+                   f"{m.get('content', '')}<|im_end|>")
+    out.append("<|im_start|>assistant<|im_sep|>")
+    return "".join(out)
+
+
+def _mistral(messages) -> str:
+    """mistral-instruct (reference mistral-instruct.jinja): a leading
+    system message rides after the BOS as plain text (NOT inside the
+    first [INST]); content is trimmed.  A NON-leading system message —
+    where the reference jinja raise_exception's on the broken
+    alternation — folds into the next user turn instead of being
+    dropped or failing the request."""
+    msgs = list(messages)
+    lead = ""
+    if msgs and msgs[0].get("role") == "system":
+        lead = msgs[0].get("content", "").strip() + "\n\n"
+        msgs = msgs[1:]
+    out = ["<s>" + lead]
+    pending_system = ""
+    for m in msgs:
+        role, content = m.get("role"), m.get("content", "").strip()
         if role == "system":
-            system = content
+            pending_system = content
         elif role == "user":
-            body = f"{system}\n\n{content}" if system else content
-            system = ""
+            body = (f"{pending_system}\n\n{content}" if pending_system
+                    else content)
+            pending_system = ""
             out.append(f"[INST] {body} [/INST]")
-        else:
+        elif role == "assistant":
             out.append(f" {content}</s>")
     return "".join(out)
 
 
-def _deepseek(messages) -> str:
-    """DeepSeek V3/R1 (and the R1 distills, whose tokenizer configs
-    carry the same template): ``<｜User｜>``/``<｜Assistant｜>`` turns
-    after an optional leading system block (reference templates
-    tool-chat-deepseek{r1,v3}.jinja)."""
-    out = ["<｜begin▁of▁sentence｜>"]
+def _deepseek(messages, strip_think: bool = False) -> str:
+    """DeepSeek V3/R1 and the R1 distills (reference templates
+    deepseek-r1-distill-*.jinja, tool-chat-deepseek{r1,v3}.jinja): the
+    system prompt — wherever it appears — is COLLECTED and emitted once
+    after the BOS, then ``<｜User｜>``/``<｜Assistant｜>`` turns.  The
+    reasoning variants (``strip_think``) drop everything before the
+    final ``</think>`` from prior assistant turns, exactly like the
+    reference distill templates."""
+    system = ""
+    for m in messages:               # LAST system wins (reference ns.
+        if m.get("role") == "system":  # system_prompt overwrite loop)
+            system = m.get("content", "")
+    out = ["<｜begin▁of▁sentence｜>" + system]
     for m in messages:
         role, content = m.get("role"), m.get("content", "")
-        if role == "system":
-            out.append(content)
-        elif role == "user":
+        if role == "user":
             out.append(f"<｜User｜>{content}")
-        else:
+        elif role == "assistant":
+            if strip_think and "</think>" in content:
+                content = content.split("</think>")[-1]
             out.append(f"<｜Assistant｜>{content}<｜end▁of▁sentence｜>")
     out.append("<｜Assistant｜>")
     return "".join(out)
+
+
+def _deepseek_r1(messages) -> str:
+    return _deepseek(messages, strip_think=True)
 
 
 def _generic(messages) -> str:
@@ -91,13 +136,21 @@ def _generic(messages) -> str:
 
 
 _FAMILY_TEMPLATES = (
-    # deepseek FIRST: the R1 distills carry llama/qwen in their names
-    # but ship DeepSeek's own chat template
+    # ORDER ENCODES PRESET-LEVEL SPECIFICITY (most specific first, the
+    # way tool formats key off the preset in engine/parsers.py):
+    # - the R1 distills carry llama/qwen in their names but ship
+    #   DeepSeek's template, and the reasoning variants strip <think>
+    # - phi-3-small adds a BOS to the phi-3 shape; phi-4 switched the
+    #   family to ChatML-with-<|im_sep|> (reference templates phi-3,
+    #   phi-3-small, phi-4 .jinja all differ)
+    (("deepseek-r1", "r1-distill"), _deepseek_r1),
     (("deepseek",), _deepseek),
     (("llama-3", "llama3"), _llama3),
     (("qwen", "chatml", "gpt-oss"), _chatml),
     (("gemma",), _gemma),
-    (("phi-", "phi3", "phi4"), _phi),
+    (("phi-3-small",), _phi3_small),
+    (("phi-4", "phi4"), _phi4),
+    (("phi-", "phi3"), _phi3),
     (("mistral", "ministral", "mixtral"), _mistral),
 )
 

@@ -1572,12 +1572,6 @@ class InferenceEngine:
                         self.cache = import_arrays(
                             self.cache, slot.pages[:n_pages], k, v)
                         slot.importing = False
-                        # a completed transfer calibrates the link side
-                        # of the break-even model with the observed
-                        # end-to-end wire bandwidth
-                        if ci.t0 is not None:
-                            self.pd_costs.note_transfer(
-                                ci.bytes_fed, time.monotonic() - ci.t0)
                         self._begin_decode(i, ci.first_token, n)
                         did = True
                 except Exception as e:

@@ -454,11 +454,6 @@ class ChunkedImport:
         self.n_scattered = 0          # chunks assembled into host buffers
         self._pending: list[tuple[int, bytes]] = []
         self._n_fed = 0
-        self.bytes_fed = 0            # wire bytes received (cost model)
-        # bandwidth clock starts at the FIRST chunk arrival, not at
-        # admission: slot-queue wait must not be charged to the link
-        # (the prefill clock likewise starts at first dispatch)
-        self.t0: Optional[float] = None
         self._last_fed = time.monotonic()
         self._error: Optional[str] = None
         self._lock = threading.Lock()
@@ -476,10 +471,7 @@ class ChunkedImport:
         with self._lock:
             self._pending.append((idx, payload))
             self._n_fed += 1
-            self.bytes_fed += len(payload)
             self._last_fed = time.monotonic()
-            if self.t0 is None:
-                self.t0 = self._last_fed
 
     def set_error(self, msg: str) -> None:
         with self._lock:

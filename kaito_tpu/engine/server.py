@@ -484,12 +484,23 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         def pull():
             ci = req.kv_chunked
             try:
+                t0 = time.monotonic()
+                nbytes = 0
                 for i in range(len(plans)):
                     with urllib.request.urlopen(
                             f"{url}/pd/kv/{req_id}/chunk/{i}",
                             timeout=120) as r:
-                        ci.feed(i, r.read())
+                        data = r.read()
+                    nbytes += len(data)
+                    ci.feed(i, data)
                     eng._wake.set()
+                # pure wire time, measured where the bytes move: from
+                # before the FIRST chunk request to the last byte read
+                # (no admission wait, no scatter latency) — this is the
+                # link-bandwidth sample the break-even model consumes
+                costs = getattr(eng, "pd_costs", None)
+                if costs is not None:
+                    costs.note_transfer(nbytes, time.monotonic() - t0)
             except Exception as e:
                 ci.set_error(f"chunk pull from {url} failed: {e}")
                 eng._wake.set()

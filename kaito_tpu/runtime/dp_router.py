@@ -105,17 +105,23 @@ def make_router_server(router: DPRouter, host: str = "0.0.0.0",
                 return
             length = int(self.headers.get("Content-Length") or 0)
             body = self.rfile.read(length) if length else None
+            # failover is only safe BEFORE the first response byte: a
+            # backend that dies mid-stream cannot be retried without
+            # corrupting the client's half-written reply (and without
+            # re-running the inference) — abort the connection instead
             tried = 0
             while tried < len(router.backends):
                 b = router.next_backend()
                 tried += 1
                 try:
-                    self._forward(b, method, body)
-                    return
+                    resp, conn = self._connect(b, method, body)
                 except (ConnectionError, OSError) as e:
                     logger.warning("backend %s unreachable (%s); skipping",
                                    b.url, e)
                     b.mark_down()
+                    continue
+                self._stream_response(b, resp, conn)
+                return
             self.send_response(503)
             msg = b'{"error": "no live backend"}'
             self.send_header("Content-Type", "application/json")
@@ -123,40 +129,62 @@ def make_router_server(router: DPRouter, host: str = "0.0.0.0",
             self.end_headers()
             self.wfile.write(msg)
 
-        def _forward(self, b: _Backend, method: str,
-                     body: Optional[bytes]) -> None:
+        def _connect(self, b: _Backend, method: str,
+                     body: Optional[bytes]):
+            """Send the request and read the response HEAD; raises are
+            retryable (nothing has reached the client yet)."""
             conn = http.client.HTTPConnection(b.host, b.port, timeout=600)
             headers = {k: v for k, v in self.headers.items()
                        if k.lower() not in HOP_HEADERS}
             conn.request(method, self.path, body=body, headers=headers)
-            resp = conn.getresponse()
-            self.send_response(resp.status)
-            chunked = False
-            for k, v in resp.getheaders():
-                if k.lower() in HOP_HEADERS:
-                    chunked = chunked or (k.lower() == "transfer-encoding"
-                                          and "chunked" in v.lower())
-                    continue
-                self.send_header(k, v)
-            has_len = resp.getheader("Content-Length") is not None
-            if not has_len:
-                # stream of unknown length (SSE): relay chunked
-                self.send_header("Transfer-Encoding", "chunked")
-            self.end_headers()
-            # relay bytes AS THEY ARRIVE so SSE tokens stream through
-            while True:
-                chunk = resp.read1(65536) if hasattr(resp, "read1") \
-                    else resp.read(65536)
-                if not chunk:
-                    break
-                if has_len:
-                    self.wfile.write(chunk)
-                else:
-                    self.wfile.write(b"%x\r\n%s\r\n" % (len(chunk), chunk))
-                self.wfile.flush()
-            if not has_len:
-                self.wfile.write(b"0\r\n\r\n")
-            conn.close()
+            return conn.getresponse(), conn
+
+        def _stream_response(self, b: _Backend, resp, conn) -> None:
+            """Relay an already-open backend response.  A BACKEND read
+            failure marks it down and aborts the client connection (no
+            retry — bytes are already out); a CLIENT write failure just
+            ends the relay (the backend is healthy)."""
+            try:
+                self.send_response(resp.status)
+                for k, v in resp.getheaders():
+                    if k.lower() not in HOP_HEADERS:
+                        self.send_header(k, v)
+                has_len = resp.getheader("Content-Length") is not None
+                if not has_len:
+                    # stream of unknown length (SSE): relay chunked
+                    self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                # relay bytes AS THEY ARRIVE so SSE tokens stream through
+                while True:
+                    try:
+                        chunk = resp.read1(65536) if hasattr(resp, "read1") \
+                            else resp.read(65536)
+                    except (ConnectionError, OSError) as e:
+                        logger.warning("backend %s died mid-stream (%s); "
+                                       "aborting relay", b.url, e)
+                        b.mark_down()
+                        self.close_connection = True
+                        return
+                    if not chunk:
+                        break
+                    try:
+                        if has_len:
+                            self.wfile.write(chunk)
+                        else:
+                            self.wfile.write(
+                                b"%x\r\n%s\r\n" % (len(chunk), chunk))
+                        self.wfile.flush()
+                    except (ConnectionError, OSError):
+                        # client went away: backend stays healthy
+                        self.close_connection = True
+                        return
+                if not has_len:
+                    try:
+                        self.wfile.write(b"0\r\n\r\n")
+                    except (ConnectionError, OSError):
+                        self.close_connection = True
+            finally:
+                conn.close()
 
         def do_GET(self):
             self._relay("GET")

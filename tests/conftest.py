@@ -27,7 +27,7 @@ import pytest  # noqa: E402
 # operator/controller/RAG/API surface in well under a minute.
 _SLOW_MODULES = {
     "test_chunked_prefill", "test_cp_serve", "test_decode_run_ahead",
-    "test_dp_serve",
+    "test_dp_router", "test_dp_serve",
     "test_e2e_sim", "test_engine_core", "test_engine_model",
     "test_engine_tp", "test_engine_tp_features", "test_flash_prefill",
     "test_host_offload", "test_kind_e2e", "test_mla", "test_moe_ragged",

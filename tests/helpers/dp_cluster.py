@@ -1,0 +1,75 @@
+"""Data-parallel 2-process serving: N independent single-process engine
+servers (each its own OS process and jax runtime) behind the in-repo
+DP router (kaito_tpu/runtime/dp_router.py) — the replica tier's data
+plane over REAL process boundaries, used by tests/test_dp_router.py
+and the driver's dp-over-2-procs dryrun leg."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from contextlib import contextmanager
+
+from tests.helpers.mh_cluster import REPO, free_port
+
+
+@contextmanager
+def boot_dp(n_backends: int = 2, extra_args=(), timeout_s: float = 240.0):
+    """Yield (router_url, backend_urls, router) with every backend
+    healthy behind the round-robin front."""
+    from kaito_tpu.runtime.dp_router import DPRouter, make_router_server
+
+    ports = [free_port() for _ in range(n_backends)]
+    procs = []
+    try:
+        for p in ports:
+            env = dict(os.environ)
+            env.update({
+                "JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+            })
+            # each replica is its own process: own jax runtime, own
+            # engine, no shared state — the InferenceSet replica shape
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "kaito_tpu.engine.server",
+                 "--model", "tiny-llama-test", "--port", str(p),
+                 "--max-model-len", "128", "--dtype", "float32",
+                 "--max-num-seqs", "2"] + list(extra_args),
+                env=env, cwd=REPO,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+        urls = [f"http://127.0.0.1:{p}" for p in ports]
+        deadline = time.monotonic() + timeout_s
+        for u in urls:
+            while True:
+                if time.monotonic() > deadline:
+                    tails = [p.stdout.read().decode(errors="replace")[-2000:]
+                             for p in procs if p.poll() is not None]
+                    raise RuntimeError(f"dp backend {u} never became "
+                                       f"healthy; dead tails: {tails}")
+                try:
+                    with urllib.request.urlopen(u + "/health",
+                                                timeout=5) as r:
+                        if r.status == 200:
+                            break
+                except Exception:
+                    time.sleep(1.0)
+        router = DPRouter(urls)
+        srv = make_router_server(router, host="127.0.0.1", port=0)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            yield (f"http://127.0.0.1:{srv.server_address[1]}", urls,
+                   router)
+        finally:
+            srv.shutdown()
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()

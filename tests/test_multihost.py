@@ -51,6 +51,25 @@ def cluster_pp():
                               "--tensor-parallel-size", "2"])
 
 
+@pytest.fixture(scope="module")
+def cluster_ep():
+    """EP over 2 processes: experts split across the process boundary
+    (expert axis spans both hosts' devices), TP inside each process —
+    the MoE serving tier the planner's expert carve-out targets."""
+    yield from _boot_cluster(["--model", "tiny-moe-real",
+                              "--expert-parallel-size", "2",
+                              "--tensor-parallel-size", "2"])
+
+
+def test_multihost_ep_serves_completions(cluster_ep):
+    body = {"model": "tiny-moe-real", "prompt": "experts across processes",
+            "max_tokens": 8, "temperature": 0}
+    out = _post(cluster_ep + "/v1/completions", body)
+    assert out["usage"]["completion_tokens"] == 8
+    out2 = _post(cluster_ep + "/v1/completions", body)
+    assert out2["choices"][0]["text"] == out["choices"][0]["text"]
+
+
 def test_multihost_serves_completions(cluster):
     body = {"model": "tiny-llama-test", "prompt": "multi host hello",
             "max_tokens": 8, "temperature": 0}

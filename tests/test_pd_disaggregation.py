@@ -72,6 +72,11 @@ def test_pd_handoff_matches_monolithic(pd_pair):
     assert text == mono_text
     # staged KV is consumed (every chunk served -> entry dropped)
     assert len(prefill_engine.kv_exports) == 0
+    # the puller fed a pure-wire bandwidth sample to the decode pod's
+    # break-even model
+    snap = decode_engine.pd_costs.snapshot()
+    assert snap["transfer_samples"] >= 1
+    assert snap["net_bytes_s"] > 0
 
 
 def test_pd_breakeven_recompute_fallback(pd_pair):
@@ -158,11 +163,6 @@ def test_pd_chunked_token_parity():
         list(req.stream())
         assert req.finish_reason != "error"
         assert list(req.output_tokens) == ref_out
-        # the completed transfer calibrated the link side of the
-        # break-even model
-        snap = cons.pd_costs.snapshot()
-        assert snap["transfer_samples"] >= 1
-        assert snap["net_bytes_s"] > 0
     finally:
         cons.stop()
         prod.stop()
