@@ -343,18 +343,16 @@ class InferenceEngine:
         self._capacity_tokens = (num_pages - 1) * cfg.page_size
         self.host_kv = None
         if cfg.host_kv_offload_bytes > 0:
-            if self.pp_exec is not None and jax.process_count() > 1:
-                # spilling a pipeline-sharded pool needs per-host shard
-                # handling; multi-process PP keeps preempt-recompute
-                logger.warning(
-                    "host KV offload is not supported on multi-process "
-                    "pipeline engines; falling back to preempt-recompute")
-            else:
-                from kaito_tpu.engine.host_offload import HostKVPool
+            from kaito_tpu.engine.host_offload import HostKVPool
 
-                self.host_kv = HostKVPool(cfg.host_kv_offload_bytes)
-                logger.info("host KV offload tier: %.2f GiB",
-                            cfg.host_kv_offload_bytes / 2**30)
+            # multi-process pipeline engines spill PER-HOST SHARDS
+            # (host_offload._HostShards): each lockstep process keeps
+            # its own slice of the gathered pages and restore
+            # reassembles the global array — preemption costs a page
+            # restore at every parallelism tier, never a recompute
+            self.host_kv = HostKVPool(cfg.host_kv_offload_bytes)
+            logger.info("host KV offload tier: %.2f GiB",
+                        cfg.host_kv_offload_bytes / 2**30)
         S = cfg.max_num_seqs
         self.slots = [_Slot() for _ in range(S)]
         self.page_tables = np.zeros((S, self.pages_per_seq), np.int32)
@@ -1811,9 +1809,21 @@ class InferenceEngine:
         bucket = entry.k.shape[page_axis]
         ids = np.zeros((bucket,), np.int32)
         ids[:n_pages] = slot.pages[:n_pages]
+        from kaito_tpu.engine.host_offload import _HostShards
+
         ids, ek, ev = jnp.asarray(ids), entry.k, entry.v
         mesh = self.mesh or (self.pp_exec.mesh if self.pp_exec else None)
-        if mesh is not None:
+        if isinstance(ek, _HostShards):
+            # multi-process entry: every lockstep process contributes
+            # its shards; the slab comes back with its ORIGINAL pool
+            # sharding, so the scatter below is shard-local
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            ek, ev = ek.rebuild(), ev.rebuild()
+            ids = jax.device_put(np.asarray(ids),
+                                 NamedSharding(mesh, P()))
+        elif mesh is not None:
             # host-pool entries are committed to the host device; the
             # pool spans the mesh — replicate the operands first so the
             # jitted scatter sees one consistent device set

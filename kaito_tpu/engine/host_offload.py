@@ -15,8 +15,17 @@ Dropping an entry is always safe: resume falls back to the recompute
 path the scheduler already has.  Covers single-chip, TP, and
 single-process PP engines (the page-id contract is layout-independent;
 ``page_axis=2`` addresses the stage-split [S, L/S, pages, ...] pool,
-and the engine pins the restored pool's sharding via out_shardings);
-multi-process PP keeps the recompute fallback.
+and the engine pins the restored pool's sharding via out_shardings).
+
+Multi-process engines (a pipeline across hosts) spill PER-HOST SHARDS:
+the gathered page slab is not fully addressable from any one process,
+so each process stores its own shards (``_HostShards``) and restore
+reassembles the global array with
+``jax.make_array_from_single_device_arrays``.  Pool accounting uses
+the GLOBAL byte size on every process so the lockstep schedulers make
+identical LRU-eviction decisions — a per-host byte count would diverge
+the replicas (uneven shards => different evictions => one process
+restores while another recomputes).
 """
 
 from __future__ import annotations
@@ -34,10 +43,28 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 
+class _HostShards:
+    """This process's shards of a multi-process-sharded slab, copied to
+    host numpy (synchronous D2H of the LOCAL bytes only — spills are
+    preemption-rate, not decode-rate).  ``rebuild`` reassembles the
+    global array; every process contributes its own shards in lockstep."""
+
+    def __init__(self, arr: jax.Array):
+        self.shape = arr.shape
+        self.sharding = arr.sharding
+        self.shards = [(s.device, np.asarray(s.data))
+                       for s in arr.addressable_shards]
+
+    def rebuild(self) -> jax.Array:
+        return jax.make_array_from_single_device_arrays(
+            self.shape, self.sharding,
+            [jax.device_put(a, d) for d, a in self.shards])
+
+
 @dataclass
 class HostKVEntry:
-    k: jax.Array          # [L, n_pages, ps, H, D] on the host backend
-    v: jax.Array          # ([S, L/S, n_pages, ...] on PP engines)
+    k: object             # jax.Array on the host backend, or _HostShards
+    v: object             # ([S, L/S, n_pages, ...] on PP engines)
     written: int          # tokens whose KV the pages hold
     nbytes: int
     n_pages: int          # padded page-bucket size (layout-independent)
@@ -73,15 +100,27 @@ class HostKVPool:
             _, old = self._entries.popitem(last=False)
             self.used_bytes -= old.nbytes
             self.evicted_entries += 1
-        if self._host_dev is not None:
+        n_pages = k.shape[page_axis]
+        if not getattr(k, "is_fully_addressable", True):
+            # multi-process pool (pipeline across hosts): every process
+            # stores ITS shards; restore reassembles the global array.
+            # Accounting divides the global size by the process count —
+            # identical on every lockstep process (so eviction decisions
+            # stay replicated) AND proportional to what each host
+            # actually holds (charging global bytes would evict at
+            # 1/process_count of the configured tier)
+            jax.block_until_ready((k, v))
+            nbytes = max(1, nbytes // jax.process_count())
+            k, v = _HostShards(k), _HostShards(v)
+        elif self._host_dev is not None:
             # async D2H: enqueued ahead of any later donating step
             k = jax.device_put(k, self._host_dev)
             v = jax.device_put(v, self._host_dev)
         self._entries[req_id] = HostKVEntry(
             k=k, v=v, written=written, nbytes=nbytes,
-            n_pages=k.shape[page_axis])
+            n_pages=n_pages)
         self.used_bytes += nbytes
-        self.spilled_pages += k.shape[page_axis]
+        self.spilled_pages += n_pages
         return True
 
     def has(self, req_id: str) -> bool:

@@ -611,6 +611,9 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                 repetition_penalty=float(body.get("repetition_penalty", 1.0)
                                          or 1.0),
                 min_p=float(body.get("min_p", 0.0) or 0.0),
+                # vLLM extra-param parity: benchmarking/tests pin exact
+                # generation lengths with ignore_eos
+                ignore_eos=bool(body.get("ignore_eos", False)),
             )
         except (TypeError, ValueError) as e:
             return self._error(400, f"bad parameter: {e}")

@@ -45,9 +45,10 @@ def boot_dp(n_backends: int = 2, extra_args=(), timeout_s: float = 240.0):
         deadline = time.monotonic() + timeout_s
         for u in urls:
             while True:
-                if time.monotonic() > deadline:
+                dead = [p for p in procs if p.poll() is not None]
+                if dead or time.monotonic() > deadline:
                     tails = [p.stdout.read().decode(errors="replace")[-2000:]
-                             for p in procs if p.poll() is not None]
+                             for p in dead]
                     raise RuntimeError(f"dp backend {u} never became "
                                        f"healthy; dead tails: {tails}")
                 try:

@@ -118,6 +118,57 @@ def test_multihost_pp_concurrent_requests(cluster_pp):
     assert all(o["usage"]["completion_tokens"] == 6 for o in outs)
 
 
+@pytest.fixture(scope="module")
+def cluster_pp_spill(tmp_path_factory):
+    """A 2-process pipeline cluster with a TINY page pool and the host
+    KV offload tier on: preemption under page pressure must spill
+    per-host shards and restore them instead of recomputing (the last
+    parallelism tier that used to fall back to recompute)."""
+    cfg = tmp_path_factory.mktemp("ppspill") / "engine.yaml"
+    cfg.write_text("engine:\n  page-size: 16\n")
+    yield from _boot_cluster([
+        "--pipeline-parallel-size", "2", "--tensor-parallel-size", "2",
+        "--max-pages", "4", "--max-num-seqs", "2",
+        "--kaito-config-file", str(cfg),
+        "--kaito-kv-cache-cpu-memory-utilization", "0.02"])
+
+
+def test_multihost_pp_preempt_restores_from_host(cluster_pp_spill):
+    """Two concurrent generations overflow the tiny page pool, so the
+    newest preempts mid-decode; with the offload tier it must resume
+    from restored host pages — greedy output identical to running the
+    same request uncontended — and the restore counter must move."""
+    import concurrent.futures as cf
+    import urllib.request as _ur
+
+    base = cluster_pp_spill
+
+    def gen(prompt):
+        return _post(base + "/v1/completions", {
+            "model": "tiny-llama-test", "prompt": prompt,
+            "max_tokens": 42, "temperature": 0, "ignore_eos": True},
+            timeout=600)
+
+    # uncontended references (greedy => deterministic)
+    solo_a = gen("spill victim alpha")
+    solo_b = gen("spill victim beta")
+
+    with cf.ThreadPoolExecutor(2) as ex:
+        fa = ex.submit(gen, "spill victim alpha")
+        fb = ex.submit(gen, "spill victim beta")
+        got_a, got_b = fa.result(), fb.result()
+    assert got_a["choices"][0]["text"] == solo_a["choices"][0]["text"]
+    assert got_b["choices"][0]["text"] == solo_b["choices"][0]["text"]
+
+    metrics = _ur.urlopen(base + "/metrics", timeout=30).read().decode()
+    vals = {l.split()[0]: float(l.split()[1]) for l in metrics.splitlines()
+            if l and not l.startswith("#")}
+    assert vals.get("kaito:num_preemptions_total", 0) >= 1, \
+        "pool pressure never forced a preemption — test shape is wrong"
+    assert vals.get("kaito:host_kv_restored_pages_total", 0) >= 1, \
+        "preemption recomputed instead of restoring from host shards"
+
+
 def test_multihost_health_contract(cluster):
     """The worker health probe contract: coordinator reachable."""
     from kaito_tpu.runtime.health import coordinator_reachable, \
