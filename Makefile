@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: all native unit-test unit-test-fast unit-test-slow engine-test rag-test bench serve manager clean
+.PHONY: all native unit-test unit-test-fast unit-test-slow engine-test rag-test chaos bench serve manager clean
 
 all: native
 
@@ -27,6 +27,12 @@ engine-test:
 
 rag-test:
 	$(PYTHON) -m pytest tests/test_rag.py -q
+
+# fault-injection suite (docs/failure-domains.md): registry/router
+# chaos runs in the fast tier too; this target adds the compile-heavy
+# engine containment tests
+chaos:
+	$(PYTHON) -m pytest tests/test_failpoints.py -q
 
 bench:
 	$(PYTHON) bench.py

@@ -95,6 +95,11 @@ def _mistral(messages) -> str:
             out.append(f"[INST] {body} [/INST]")
         elif role == "assistant":
             out.append(f" {content}</s>")
+    if pending_system:
+        # a TRAILING system message (no user turn after it) still has
+        # to steer the generation — emit it as its own instruction
+        # block instead of silently dropping it
+        out.append(f"[INST] {pending_system} [/INST]")
     return "".join(out)
 
 

@@ -77,6 +77,13 @@ class EngineConfig:
     pd_enabled: bool = False             # P/D side-channel routes (MRI roles)
     pd_source_allowlist: str = ""        # comma URL prefixes for KV pulls
     max_queue_len: int = 256
+    # failure-domain isolation (docs/failure-domains.md)
+    request_timeout_s: float = 0.0       # server-default deadline (0 = off);
+    # clients may tighten per request via the body's "timeout" field
+    kv_shed_threshold: float = 0.0       # shed new work with 429 when KV-page
+    # usage crosses this fraction while a queue exists (0 = off)
+    kv_import_retries: int = 1           # transient KV-transfer failures fall
+    # back to local recompute this many times before failing the request
 
     def replace(self, **kw) -> "EngineConfig":
         return dataclasses.replace(self, **kw)

@@ -141,12 +141,13 @@ class DataParallelEngine:
 
     def submit(self, prompt_tokens, params: SamplingParams,
                req_id: Optional[str] = None, export_kv: bool = False,
-               adapter: str = "") -> Request:
+               adapter: str = "",
+               timeout_s: Optional[float] = None) -> Request:
         if export_kv:
             raise RuntimeError("P/D KV export requires data_parallel=1")
         eng = self._pick()
         req = eng.submit(prompt_tokens, params, req_id=req_id,
-                         adapter=adapter)
+                         adapter=adapter, timeout_s=timeout_s)
         req._dp_group = eng
         return req
 

@@ -45,8 +45,24 @@ from kaito_tpu.engine.tokenizer import load_tokenizer
 from kaito_tpu.estimator.estimator import PER_CHIP_OVERHEAD_BYTES, HBM_UTILIZATION
 from kaito_tpu.models.metadata import ModelMetadata
 from kaito_tpu.models.registry import get_model_by_name
+from kaito_tpu.utils.failpoints import FAILPOINTS
 
 logger = logging.getLogger(__name__)
+
+
+class RequestScopedError(RuntimeError):
+    """An exception the scheduler loop can attribute to ONE request.
+
+    Raising this (instead of a bare exception) from inside ``step``
+    tells ``_loop`` that the failure domain is a single request — the
+    loop fails that request with a structured error and keeps serving
+    everyone else, instead of taking the ``_fail_all`` engine-fatal
+    path.  The request must already be detached from its slot (pages
+    released) by the raiser."""
+
+    def __init__(self, req: "Request", message: str = ""):
+        super().__init__(message or f"request {req.req_id} failed")
+        self.req = req
 
 # columns in the fused-decode on-device stop matrix; requests with more
 # stop ids than this fall back to the single-step path
@@ -98,6 +114,18 @@ class Request:
     preemptions: int = 0
     prompt_counted: bool = False   # metrics: prompt tokens counted once
     adapter: str = ""              # per-request LoRA adapter name
+    # failure-domain isolation: absolute monotonic deadline (None = no
+    # deadline), structured error surfaced to the HTTP layer when
+    # finish_reason lands on "error"/"deadline", and the remaining
+    # retry budget for TRANSIENT KV-transfer failures (retrying falls
+    # back to local recompute — the request still succeeds, just slower)
+    deadline: Optional[float] = None
+    error: Optional[dict] = None
+    kv_retries: int = 0
+
+    @property
+    def expired(self) -> bool:
+        return self.deadline is not None and time.monotonic() > self.deadline
 
     def resume_tokens(self) -> list[int]:
         """Prompt plus everything generated so far — what a preempted
@@ -398,7 +426,14 @@ class InferenceEngine:
             "spec_proposed_tokens_total": 0,
             "spec_accepted_tokens_total": 0,
             "pd_device_handoffs_total": 0,
+            # failure-domain isolation
+            "requests_failed_total": 0,       # request-scoped failures
+            "requests_expired_total": 0,      # deadline-aborted (408)
+            "kv_import_retries_total": 0,     # transient -> local recompute
+            "engine_fatal_total": 0,          # _fail_all escalations
         }
+        self._last_deadline_sweep = 0.0
+        self._last_export_tick = 0.0
 
         self._decode_fn = self._build_decode_fn()
         self._prefill_fns: dict[int, object] = {}
@@ -1031,15 +1066,61 @@ class InferenceEngine:
         if params.max_tokens < 1:
             raise ValueError(f"max_tokens must be >= 1, got {params.max_tokens}")
 
+    def _validate_kv_meta(self, meta: dict, n_prompt: int,
+                          strict_shape: bool = False) -> None:
+        """Reject an incompatible KV handoff in the REQUEST thread (a
+        clean 4xx) instead of letting the scatter explode inside the
+        scheduler loop: model identity and token count always; with
+        ``strict_shape`` (the colocated device path, where the slabs
+        land in the pool as-is) the wire shape's layer count, page
+        count, page_size and head layout must match this engine's pool
+        too.  Chunked imports stay lenient: their assemble step
+        re-checks per-chunk shapes against the host buffers anyway."""
+        if meta.get("model") not in ("", None, self.md.name):
+            raise ValueError(f"KV transfer model mismatch: {meta.get('model')} "
+                             f"!= {self.md.name}")
+        if meta.get("n_tokens") not in (None, n_prompt):
+            raise ValueError(
+                f"KV transfer token mismatch: client sent {n_prompt} prompt "
+                f"tokens, staged slab holds {meta.get('n_tokens')}")
+        shape = meta.get("shape")
+        if not strict_shape or not shape:
+            return
+        shape = tuple(int(s) for s in shape)
+        staged = self.cache.k.ndim == len(shape) + 1
+        if not staged and self.cache.k.ndim != len(shape):
+            raise ValueError(f"KV slab rank mismatch: wire shape {shape} vs "
+                             f"pool rank {self.cache.k.ndim}")
+        L = (self.cache.k.shape[0] * self.cache.k.shape[1]) if staged \
+            else self.cache.k.shape[0]
+        tail = tuple(self.cache.k.shape[3 if staged else 2:])
+        n_pages = -(-n_prompt // self.cfg.page_size)
+        # page count is a floor, not an equality: exporters may ship a
+        # rounded-up slab; layer count and the per-page layout must
+        # match this pool exactly
+        if shape[0] != L or shape[2:] != tail or shape[1] < n_pages:
+            raise ValueError(
+                f"KV slab incompatible with this engine: wire shape {shape}, "
+                f"pool expects ({L}, >={n_pages}) + {tail} (layers, prompt "
+                f"pages, page_size, kv heads, head dim)")
+
+    def _deadline_for(self, timeout_s: Optional[float]) -> Optional[float]:
+        """Absolute monotonic deadline from a per-request timeout,
+        falling back to the server default (0 = no deadline)."""
+        t = timeout_s if timeout_s else self.cfg.request_timeout_s
+        return (time.monotonic() + float(t)) if t else None
+
     def submit(self, prompt_tokens: list[int], params: SamplingParams,
                req_id: Optional[str] = None,
-               export_kv: bool = False, adapter: str = "") -> Request:
+               export_kv: bool = False, adapter: str = "",
+               timeout_s: Optional[float] = None) -> Request:
         self._validate_submit(prompt_tokens, params)
         if adapter and adapter not in self.adapter_index:
             raise ValueError(f"unknown adapter {adapter!r}")
         req = Request(req_id or f"req-{self.counters['requests_total']}",
                       list(prompt_tokens), params, export_kv=export_kv,
-                      adapter=adapter)
+                      adapter=adapter,
+                      deadline=self._deadline_for(timeout_s))
         with self._lock:
             self.counters["requests_total"] += 1
             self._waiting_count += 1
@@ -1050,16 +1131,16 @@ class InferenceEngine:
     def submit_with_kv(self, prompt_tokens: list[int], first_token: int,
                        meta: dict, payload: bytes,
                        params: SamplingParams,
-                       req_id: Optional[str] = None) -> Request:
+                       req_id: Optional[str] = None,
+                       timeout_s: Optional[float] = None) -> Request:
         """Decode-role entry: continue a prefilled request from
         transferred KV pages."""
         self._validate_submit(prompt_tokens, params)
-        if meta.get("model") not in ("", None, self.md.name):
-            raise ValueError(f"KV transfer model mismatch: {meta.get('model')} "
-                             f"!= {self.md.name}")
+        self._validate_kv_meta(meta, len(prompt_tokens))
         req = Request(req_id or f"pd-{self.counters['requests_total']}",
                       list(prompt_tokens), params,
-                      kv_import=(meta, payload, first_token))
+                      kv_import=(meta, payload, first_token),
+                      deadline=self._deadline_for(timeout_s))
         with self._lock:
             self.counters["requests_total"] += 1
             self._waiting_count += 1
@@ -1070,7 +1151,8 @@ class InferenceEngine:
     def submit_with_kv_device(self, prompt_tokens: list[int],
                               first_token: int, meta: dict, slabs,
                               params: SamplingParams,
-                              req_id: Optional[str] = None) -> Request:
+                              req_id: Optional[str] = None,
+                              timeout_s: Optional[float] = None) -> Request:
         """Colocated decode entry: the prefill engine lives in THIS
         process, so its staged canonical KV slab hands off as a single
         device-to-device scatter — no host bounce, no wire (the
@@ -1078,22 +1160,16 @@ class InferenceEngine:
         preset_inferences.go:909-938, re-imagined for a shared slice).
         ``slabs`` is ``StagedExport.device_slabs()``."""
         self._validate_submit(prompt_tokens, params)
-        if meta.get("model") not in ("", None, self.md.name):
-            raise ValueError(f"KV transfer model mismatch: {meta.get('model')} "
-                             f"!= {self.md.name}")
-        # fail in the REQUEST thread, not the scheduler: a token count
-        # that disagrees with the staged slab would otherwise raise in
-        # _start_device_import on the engine loop (or, worse, decode
-        # silently against misaligned KV when the page counts happen
-        # to match)
-        if meta.get("n_tokens") not in (None, len(prompt_tokens)):
-            raise ValueError(
-                f"KV transfer token mismatch: client sent "
-                f"{len(prompt_tokens)} prompt tokens, staged slab holds "
-                f"{meta.get('n_tokens')}")
+        # fail in the REQUEST thread, not the scheduler: a token count,
+        # page_size or head layout that disagrees with the staged slab
+        # would otherwise raise in _start_device_import on the engine
+        # loop (or, worse, decode silently against misaligned KV when
+        # the page counts happen to match)
+        self._validate_kv_meta(meta, len(prompt_tokens), strict_shape=True)
         req = Request(req_id or f"pd-{self.counters['requests_total']}",
                       list(prompt_tokens), params,
-                      kv_device=(meta, slabs, first_token))
+                      kv_device=(meta, slabs, first_token),
+                      deadline=self._deadline_for(timeout_s))
         with self._lock:
             self.counters["requests_total"] += 1
             self._waiting_count += 1
@@ -1105,7 +1181,8 @@ class InferenceEngine:
                                first_token: int, meta: dict, plans,
                                params: SamplingParams,
                                req_id: Optional[str] = None,
-                               deadline_s: float = 120.0):
+                               deadline_s: float = 120.0,
+                               timeout_s: Optional[float] = None):
         """Decode-role entry for the CHUNKED transfer path: the request
         is admitted immediately and its KV chunks are scattered by the
         scheduler loop as the caller ``feed``s them into the returned
@@ -1115,13 +1192,13 @@ class InferenceEngine:
         from kaito_tpu.engine.pd import ChunkedImport
 
         self._validate_submit(prompt_tokens, params)
-        if meta.get("model") not in ("", None, self.md.name):
-            raise ValueError(f"KV transfer model mismatch: {meta.get('model')} "
-                             f"!= {self.md.name}")
+        self._validate_kv_meta(meta, len(prompt_tokens))
         req = Request(req_id or f"pd-{self.counters['requests_total']}",
                       list(prompt_tokens), params,
                       kv_chunked=ChunkedImport(meta, list(plans), first_token,
-                                               deadline_s=deadline_s))
+                                               deadline_s=deadline_s),
+                      deadline=self._deadline_for(timeout_s),
+                      kv_retries=max(0, self.cfg.kv_import_retries))
         with self._lock:
             self.counters["requests_total"] += 1
             self._waiting_count += 1
@@ -1167,9 +1244,23 @@ class InferenceEngine:
         while not self._stop.is_set():
             try:
                 did_work = self.step()
+            except RequestScopedError as e:
+                # failure domain: ONE request.  The raiser already
+                # detached it from its slot; fail it and keep serving —
+                # UNLESS the step donated the cache into the failure,
+                # in which case nothing in flight can survive anyway.
+                logger.warning("request-scoped failure: %s", e)
+                self._fail_request(e.req, message=str(e))
+                if self._cache_poisoned():
+                    logger.error("cache donated into a scoped failure; "
+                                 "escalating to fail-all")
+                    self.counters["engine_fatal_total"] += 1
+                    self._fail_all()
+                continue
             except Exception:
                 # A scheduler-loop failure must not strand waiting clients.
                 logger.exception("engine loop failure; failing in-flight requests")
+                self.counters["engine_fatal_total"] += 1
                 self._fail_all()
                 continue
             if not did_work:
@@ -1236,12 +1327,66 @@ class InferenceEngine:
         self.slot_adapters[slot_idx] = 0
         self.active[slot_idx] = False
 
-    def _fail_request(self, req: Request):
+    def _fail_request(self, req: Request, status: int = 500,
+                      etype: str = "internal_error",
+                      message: str = ""):
+        """Terminate ONE request with a structured error the HTTP layer
+        can surface (status/type/message), leaving the rest of the
+        engine untouched.  Idempotent on req.error: the first failure
+        report wins."""
         req.finish_reason = "error"
         req.finish_time = time.monotonic()
+        if req.error is None:
+            req.error = {"status": status, "type": etype,
+                         "message": message or
+                         f"request {req.req_id} failed in the engine"}
         if self.host_kv is not None:
             self.host_kv.discard(req.req_id)
+        self.counters["requests_failed_total"] += 1
         req.out.put(None)
+
+    def _expire_request(self, req: Request):
+        """Deadline abort: a 408-style structured error; the request
+        never consumed (or stops consuming) TPU time."""
+        req.finish_reason = "deadline"
+        req.finish_time = time.monotonic()
+        if req.error is None:
+            req.error = {"status": 408, "type": "deadline_exceeded",
+                         "message": f"request {req.req_id} exceeded its "
+                                    f"deadline before completing"}
+        if self.host_kv is not None:
+            self.host_kv.discard(req.req_id)
+        self.counters["requests_expired_total"] += 1
+        req.out.put(None)
+
+    def _expire_deadlines(self) -> bool:
+        """Sweep expired requests out of the waiting queue and the
+        decode slots (throttled from step()).  Queue expiry is the
+        cheap win — the request never touches the TPU; slot expiry
+        frees pages mid-decode so a stuck client can't pin HBM."""
+        now = time.monotonic()
+        did = False
+        with self._lock:
+            expired = [r for r in self.waiting
+                       if r.deadline is not None and now > r.deadline]
+            if expired:
+                keep = collections.deque(
+                    r for r in self.waiting
+                    if not (r.deadline is not None and now > r.deadline))
+                self.waiting = keep
+                self._waiting_count = len(keep)
+        for r in expired:
+            self._expire_request(r)
+            did = True
+        for i, slot in enumerate(self.slots):
+            req = slot.request
+            if req is not None and req.deadline is not None \
+                    and now > req.deadline:
+                self._evict_slot(i, commit=not slot.importing
+                                 and not slot.prefilling)
+                self._expire_request(req)
+                did = True
+        return did
 
     def _fail_active_slots(self):
         for i, slot in enumerate(self.slots):
@@ -1258,6 +1403,13 @@ class InferenceEngine:
                 break
             self._fail_request(req)
         self._recover_cache_if_poisoned()
+
+    def _cache_poisoned(self) -> bool:
+        """Read-only probe: was the KV pool donated into a failed step?"""
+        try:
+            return bool(self.cache.k.is_deleted())
+        except Exception:
+            return True
 
     def _recover_cache_if_poisoned(self):
         """A jitted step that raises AFTER buffer donation leaves
@@ -1310,6 +1462,18 @@ class InferenceEngine:
         is decoding), so a running batch keeps its token cadence while
         new prompts stream in.
         """
+        FAILPOINTS.fire("engine.step")
+        did0 = False
+        now = time.monotonic()
+        # deadline sweep and export-registry GC are throttled: both are
+        # O(queue+slots) walks that would otherwise tax every iteration
+        # of the hot loop
+        if now - self._last_deadline_sweep >= 0.05:
+            self._last_deadline_sweep = now
+            did0 = self._expire_deadlines()
+        if now - self._last_export_tick >= 1.0:
+            self._last_export_tick = now
+            self.kv_exports.tick()
         # ensure BEFORE admitting: growth of running sequences must not
         # be starved by a fresh admission grabbing the last pages (which
         # would be preempted right back — wasted churn)
@@ -1317,7 +1481,7 @@ class InferenceEngine:
         if self.active.any():
             la = self._decode_lookahead()
             self._ensure_decode_pages(la)
-        did = self._admit_new()
+        did = self._admit_new() or did0
         if self._advance_imports():
             did = True
         decoding = bool(self.active.any())
@@ -1374,6 +1538,11 @@ class InferenceEngine:
                 if self.host_kv is not None:
                     self.host_kv.discard(req.req_id)
                 req.out.put(None)
+                admitted = True
+                continue
+            if req.expired:
+                # queue expiry at admission: zero TPU time consumed
+                self._expire_request(req)
                 admitted = True
                 continue
             try:
@@ -1559,8 +1728,10 @@ class InferenceEngine:
                 continue
             ci = req.kv_chunked
             err = ci.error
+            transient = ci.transient
             if err is None:
                 try:
+                    FAILPOINTS.fire("engine.kv_import", req_id=req.req_id)
                     if ci.assemble():
                         did = True
                     if ci.complete:
@@ -1573,11 +1744,31 @@ class InferenceEngine:
                         self._begin_decode(i, ci.first_token, n)
                         did = True
                 except Exception as e:
+                    # assembly/scatter exceptions are NOT transient:
+                    # the bytes are wrong (shape/corruption), so the
+                    # same transfer would fail again
                     err = f"{type(e).__name__}: {e}"
+                    transient = False
             if err is not None:
-                logger.warning("KV import failed for %s: %s", req.req_id, err)
                 self._evict_slot(i, commit=False)
-                self._fail_request(req)
+                if transient and req.kv_retries > 0:
+                    # retry budget: fall back to LOCAL recompute — the
+                    # request still succeeds (slower), and the prompt
+                    # tokens are all here.  Clearing kv_chunked routes
+                    # re-admission through the normal prefill path.
+                    req.kv_retries -= 1
+                    req.kv_chunked = None
+                    self.counters["kv_import_retries_total"] += 1
+                    logger.warning("KV import for %s failed transiently "
+                                   "(%s); falling back to local recompute",
+                                   req.req_id, err)
+                    self._requeue_front(req)
+                else:
+                    logger.warning("KV import failed for %s: %s",
+                                   req.req_id, err)
+                    self._fail_request(req, status=502,
+                                       etype="kv_transfer_failed",
+                                       message=f"KV import failed: {err}")
                 did = True
         return did
 
@@ -1614,6 +1805,7 @@ class InferenceEngine:
         aid = jnp.asarray(self.slot_adapters[i:i + 1])
         t_first_chunk = time.monotonic()
         try:
+            FAILPOINTS.fire("engine.prefill", req_id=req.req_id)
             if use_cp:
                 fn = self._prefill_cp_fn(bucket)
                 self.cache, logits = fn(self.params, self.cache,
@@ -1639,10 +1831,12 @@ class InferenceEngine:
                                         jnp.asarray(self.page_tables[i][None]),
                                         jnp.asarray([pos], np.int32),
                                         aid)
-        except Exception:
+        except Exception as e:
             logger.exception("prefill failed for %s", req.req_id)
             self._evict_slot(i, commit=False)
-            self._fail_request(req)
+            self._fail_request(req, etype="prefill_failed",
+                               message=f"prefill failed: "
+                                       f"{type(e).__name__}: {e}")
             self._recover_cache_if_poisoned()
             return True
         self.counters["prefill_steps_total"] += 1
@@ -1784,13 +1978,22 @@ class InferenceEngine:
         ids = np.zeros((bucket,), np.int32)
         ids[:n_pages] = slot.pages[:n_pages]
         page_axis = 2 if self.pp_exec is not None else 1
-        k_pages, v_pages = gather_pages(
-            self.cache.k, self.cache.v, jnp.asarray(ids),
-            page_axis=page_axis)
-        if self.host_kv.put(req.req_id, k_pages, v_pages, written,
-                            page_axis=page_axis):
-            self.counters["host_kv_spilled_pages_total"] += n_pages
-        # else: entry can never fit; resume recomputes
+        try:
+            FAILPOINTS.fire("engine.spill", req_id=req.req_id)
+            k_pages, v_pages = gather_pages(
+                self.cache.k, self.cache.v, jnp.asarray(ids),
+                page_axis=page_axis)
+            if self.host_kv.put(req.req_id, k_pages, v_pages, written,
+                                page_axis=page_axis):
+                self.counters["host_kv_spilled_pages_total"] += n_pages
+            # else: entry can never fit; resume recomputes
+        except Exception:
+            # the spill is an OPTIMIZATION: a failed D2H must not take
+            # the request (or the engine) with it — drop the entry and
+            # let resume recompute from tokens
+            logger.exception("host-KV spill failed for %s; resume will "
+                             "recompute", req.req_id)
+            self.host_kv.discard(req.req_id)
 
     def _try_restore(self, req: Request, free_slot: int) -> bool:
         """Resume a spilled sequence by scattering its host pages back

@@ -149,6 +149,10 @@ class EngineMetrics:
             labels=("finished_reason",))
         self.requests_rejected = Counter(
             "kaito:request_rejected_total", "Requests rejected (rate limit)", r)
+        self.requests_shed = Counter(
+            "kaito:request_shed_total",
+            "Requests shed by admission control (429 + Retry-After)", r,
+            labels=("reason",))
         self.ttft = Histogram(
             "kaito:time_to_first_token_seconds", "Time to first token", r)
         self.tpot = Histogram(
@@ -190,6 +194,20 @@ class EngineMetrics:
                   "Colocated device-to-device KV hand-offs", r,
                   fn=lambda: engine.counters.get(
                       "pd_device_handoffs_total", 0))
+            # failure-domain isolation counters (docs/failure-domains.md)
+            Gauge("kaito:requests_failed_total",
+                  "Requests that died request-scoped (structured error)", r,
+                  fn=lambda: engine.counters.get("requests_failed_total", 0))
+            Gauge("kaito:requests_expired_total",
+                  "Requests aborted at their deadline (408)", r,
+                  fn=lambda: engine.counters.get("requests_expired_total", 0))
+            Gauge("kaito:kv_import_retries_total",
+                  "Transient KV-transfer failures retried as local recompute",
+                  r, fn=lambda: engine.counters.get(
+                      "kv_import_retries_total", 0))
+            Gauge("kaito:engine_fatal_total",
+                  "Engine-fatal failures (every in-flight request failed)", r,
+                  fn=lambda: engine.counters.get("engine_fatal_total", 0))
             # live-calibrated break-even constants (0 until the first
             # observed transfer / prefill provides a sample)
             Gauge("kaito:pd_measured_net_bytes_s",

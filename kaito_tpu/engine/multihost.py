@@ -119,7 +119,8 @@ class MultiHostEngine(InferenceEngine):
         self._abort_requested: set[str] = set()
 
     def submit(self, prompt_tokens, params, req_id=None,
-               export_kv=False, adapter: str = "") -> Request:
+               export_kv=False, adapter: str = "",
+               timeout_s=None) -> Request:
         if not self.is_leader:
             raise RuntimeError("submit() is leader-only; workers receive "
                                "requests via the step broadcast")
@@ -139,7 +140,8 @@ class MultiHostEngine(InferenceEngine):
                 params = dataclasses.replace(
                     params, seed=self.counters["requests_total"])
             req = Request(req_id or f"req-{self.counters['requests_total']}",
-                          list(prompt_tokens), params, adapter=adapter)
+                          list(prompt_tokens), params, adapter=adapter,
+                          deadline=self._deadline_for(timeout_s))
             self._staged.append(req)
         self._wake.set()
         return req
@@ -151,6 +153,35 @@ class MultiHostEngine(InferenceEngine):
         with self._lock:
             self._abort_requested.add(req.req_id)
         self._wake.set()
+
+    def _expire_deadlines(self) -> bool:
+        """Deadline expiry must be deterministic across processes: the
+        wire format is clock-free, so worker replicas carry no deadline
+        and a local wall-clock sweep would expire a request on the
+        leader only — diverging the lockstep schedulers.  The leader
+        instead converts expirations into broadcast aborts, so every
+        process retires the request at the same step boundary."""
+        if not self.is_leader:
+            return False
+        now = time.monotonic()
+        did = False
+        with self._lock:
+            live = list(self._live.values()) + list(self._staged)
+            for r in live:
+                if r.deadline is not None and now > r.deadline \
+                        and not r.aborted and r.finish_time is None:
+                    if r.error is None:
+                        r.error = {"status": 408,
+                                   "type": "deadline_exceeded",
+                                   "message": f"request {r.req_id} exceeded "
+                                              "its deadline before completing"}
+                    self.counters["requests_expired_total"] += 1
+                    self._abort_requested.add(r.req_id)
+                    r.deadline = None      # one broadcast abort per request
+                    did = True
+        if did:
+            self._wake.set()
+        return did
 
     def submit_with_kv_chunked(self, *a, **kw):
         raise RuntimeError(

@@ -48,11 +48,16 @@ except ImportError:  # pragma: no cover - ml_dtypes ships with jax
     pass
 
 from kaito_tpu.engine.kv_cache import KVCache
+from kaito_tpu.utils.failpoints import FAILPOINTS
 
 logger = logging.getLogger(__name__)
 
 CHUNK_TARGET_BYTES = 8 << 20
 STAGE_TTL_S = 120.0
+# lazy_drain staged exports pin HBM until the first consumer starts the
+# D2H copy; after this grace window the registry starts the drain itself
+# so an unpulled export degrades to host memory, never a pinned-HBM leak
+EXPORT_DRAIN_GRACE_S = 5.0
 
 
 # ---------------------------------------------------------------------------
@@ -244,6 +249,12 @@ class StagedExport:
         if not lazy_drain:
             self.ensure_draining()
 
+    @property
+    def draining(self) -> bool:
+        """Has the D2H copier been started (lazy or eager)?"""
+        with self._drain_lock:
+            return self._drain_started
+
     def ensure_draining(self) -> None:
         """Start the device→host copier once (idempotent)."""
         with self._drain_lock:
@@ -265,6 +276,7 @@ class StagedExport:
 
     def _drain(self):
         try:
+            FAILPOINTS.fire("pd.export_drain")
             for i, p in enumerate(self.plans):
                 k = np.asarray(self._k_dev[p.layer_lo:p.layer_hi,
                                            p.page_lo:p.page_hi])
@@ -303,7 +315,10 @@ class StagedExport:
             if consume:
                 self._chunks[i] = None
                 self._served += 1
-        return data
+        # chaos hook: an armed "pd.chunk" corrupt point flips bytes on
+        # the wire path so receive-side checksumming/shape checks are
+        # exercised end to end
+        return FAILPOINTS.corrupt("pd.chunk", data, chunk=i)
 
     def restage_chunk(self, i: int, data: bytes) -> None:
         """Put a consumed chunk back (a send failed after the claim) so
@@ -416,6 +431,21 @@ class KVExportRegistry:
         for k in dead:
             del self._items[k]
 
+    def tick(self, grace_s: float = EXPORT_DRAIN_GRACE_S) -> None:
+        """Periodic maintenance, called from the engine's step loop:
+        (a) TTL-GC abandoned entries (previously only ``put`` did this,
+        so the LAST export of a burst could linger forever), and
+        (b) start the D2H drain of any lazy_drain entry older than the
+        grace window whose colocated consumer never showed up — the
+        staged device slabs move to host and unpin HBM."""
+        now = time.monotonic()
+        with self._lock:
+            self._gc()
+            stale = [e for e in self._items.values()
+                     if not e.draining and now - e.created > grace_s]
+        for e in stale:
+            e.ensure_draining()
+
     def __len__(self) -> int:
         """Live (not-yet-exhausted) entries.  A fully-served export is
         logically gone the moment its last chunk is claimed — physical
@@ -456,6 +486,7 @@ class ChunkedImport:
         self._n_fed = 0
         self._last_fed = time.monotonic()
         self._error: Optional[str] = None
+        self._transient = False
         self._lock = threading.Lock()
         shape = tuple(meta["shape"])
         v_shape = tuple(meta.get("v_shape", meta["shape"]))
@@ -473,9 +504,20 @@ class ChunkedImport:
             self._n_fed += 1
             self._last_fed = time.monotonic()
 
-    def set_error(self, msg: str) -> None:
+    def set_error(self, msg: str, transient: bool = False) -> None:
+        """``transient`` marks failures worth a retry-by-recompute
+        (a network drop the puller reports immediately) as opposed to
+        permanent ones (shape/corruption) — the engine reads it to
+        decide between the local-prefill fallback and failing the
+        request."""
         with self._lock:
             self._error = msg
+            self._transient = transient
+
+    @property
+    def transient(self) -> bool:
+        with self._lock:
+            return getattr(self, "_transient", False)
 
     @property
     def error(self) -> Optional[str]:
@@ -484,6 +526,9 @@ class ChunkedImport:
                 return self._error
             if (self._n_fed < self.n_chunks
                     and time.monotonic() - self._last_fed > self.deadline_s):
+                # a stall already burned deadline_s of wall clock: fail
+                # fast (permanent) rather than silently doubling the
+                # client's latency with a recompute
                 return (f"KV transfer stalled: no chunk for "
                         f"{self.deadline_s:.0f}s "
                         f"({self._n_fed}/{self.n_chunks} arrived)")
@@ -638,10 +683,17 @@ def bench_kv_handoff(model_name: str, ctxs, on_tpu: bool) -> dict:
         n_pages = -(-ctx // page_size)
         cache = create_kv_cache(arch, n_pages + 1, page_size, dtype)
         pages = list(range(1, n_pages + 1))
-        # warm once (compile of gather/scatter programs), then measure.
-        # The import leg mirrors the engine: assemble chunks into host
-        # buffers (the overlappable work), one device scatter at the end.
-        for warm in (True, False):
+        # warm once (compile of gather/scatter programs), then measure
+        # a second, compile-free pass — only the last pass's timings are
+        # reported.  The import leg mirrors the engine: assemble chunks
+        # into host buffers (the overlappable work), one device scatter
+        # at the end.
+        staged = dest = None
+        for _ in range(2):
+            # free the warm-up pass's staged copy and dest pool BEFORE
+            # the timed pass so the measurement doesn't run against
+            # doubled HBM pressure (allocator churn skews the numbers)
+            del staged, dest
             t0 = time.monotonic()
             staged = stage_export(cache, pages, n_tokens=ctx,
                                   model=model_name, prompt_tokens=[],
@@ -670,7 +722,9 @@ def bench_kv_handoff(model_name: str, ctxs, on_tpu: bool) -> dict:
         # colocated device-to-device path (no host bounce): gather +
         # one scatter, both on device — what a shared-slice/single-host
         # MRI hand-off costs vs the host-staged wire above
-        for warm in (True, False):
+        dest2 = staged_d = None
+        for _ in range(2):
+            del dest2, staged_d     # free the warm pass before timing
             dest2 = create_kv_cache(arch, n_pages + 1, page_size, dtype)
             t2 = time.monotonic()
             staged_d = stage_export(cache, pages, n_tokens=ctx,

@@ -9,13 +9,45 @@ the Gateway/EPP retries another replica instead of piling onto this one.
 
 from __future__ import annotations
 
+from typing import Optional
+
 
 class RateLimiter:
-    def __init__(self, max_queue_len: int, disabled: bool = False):
+    def __init__(self, max_queue_len: int, disabled: bool = False,
+                 kv_shed_threshold: float = 0.0):
         self.max_queue_len = max_queue_len
         self.disabled = disabled
+        self.kv_shed_threshold = kv_shed_threshold
 
     def admit(self, num_waiting: int) -> bool:
         if self.disabled:
             return True
         return num_waiting < self.max_queue_len
+
+    def shed_reason(self, engine) -> Optional[str]:
+        """Why a NEW request should be shed right now, or None to admit.
+
+        Two pressure signals: queue depth (the original contract) and —
+        when ``kv_shed_threshold`` is set — KV-page exhaustion while a
+        queue exists (admitting more work would only grow the preempt
+        churn, not the throughput).  The HTTP layer maps any reason to
+        429 + Retry-After."""
+        if self.disabled:
+            return None
+        if engine.num_waiting >= self.max_queue_len:
+            return "queue_full"
+        if self.kv_shed_threshold > 0 and engine.num_waiting > 0:
+            try:
+                alloc = engine.allocator
+                used = 1.0 - alloc.available / max(1, alloc.num_pages - 1)
+            except Exception:
+                return None
+            if used >= self.kv_shed_threshold:
+                return "kv_pressure"
+        return None
+
+    def retry_after_s(self, engine) -> int:
+        """Advisory Retry-After: scales with the backlog so a deep
+        queue pushes clients further out instead of synchronizing their
+        retries onto the same instant."""
+        return min(30, 1 + engine.num_waiting // 8)

@@ -59,7 +59,8 @@ class ServerState:
         self.engine = engine
         self.cfg = cfg
         self.metrics = EngineMetrics(engine)
-        self.limiter = RateLimiter(cfg.max_queue_len, cfg.disable_rate_limit)
+        self.limiter = RateLimiter(cfg.max_queue_len, cfg.disable_rate_limit,
+                                   kv_shed_threshold=cfg.kv_shed_threshold)
         self.model_name = cfg.served_model_name or engine.md.name
         self.adapters = discover_adapters(cfg.adapters_dir)
         self.started = time.time()
@@ -74,16 +75,30 @@ class OpenAIHandler(BaseHTTPRequestHandler):
 
     # ---------------- helpers ----------------
 
-    def _json(self, code: int, obj: dict):
+    def _json(self, code: int, obj: dict, headers: Optional[dict] = None):
         body = json.dumps(obj).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
         self.end_headers()
         self.wfile.write(body)
 
-    def _error(self, code: int, message: str, etype: str = "invalid_request_error"):
-        self._json(code, {"error": {"message": message, "type": etype}})
+    def _error(self, code: int, message: str,
+               etype: str = "invalid_request_error",
+               headers: Optional[dict] = None):
+        self._json(code, {"error": {"message": message, "type": etype}},
+                   headers=headers)
+
+    def _request_error(self, req) -> None:
+        """Surface a request's structured engine error (scoped failure
+        or deadline abort) as the HTTP response."""
+        err = req.error or {"status": 500, "type": "internal_error",
+                            "message": "request failed in the engine"}
+        self._error(int(err.get("status", 500)),
+                    err.get("message", "request failed"),
+                    err.get("type", "internal_error"))
 
     def _read_body(self) -> Optional[dict]:
         try:
@@ -280,8 +295,8 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         except ValueError as e:
             return self._error(400, str(e))
         toks = list(req.stream())
-        if not toks and req.finish_reason == "error":
-            return self._error(500, "prefill failed")
+        if not toks and req.finish_reason in ("error", "deadline"):
+            return self._request_error(req)
         self._json(200, {"req_id": req.req_id,
                          "first_token": req.output_tokens[0],
                          "n_tokens": len(tokens),
@@ -369,7 +384,8 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             raise
         reg.drop_served(req_id)
 
-    def _submit_with_transfer(self, kv_src: dict, params):
+    def _submit_with_transfer(self, kv_src: dict, params,
+                              timeout_s: float = 0.0):
         """Continue decoding from a remote prefill's KV.
 
         Chunked overlapped pull: a handshake fetches the chunk plan,
@@ -424,7 +440,8 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                             return eng.submit_with_kv_device(
                                 prompt_tokens, first, staged.meta, slabs,
                                 params,
-                                req_id=f"cmpl-{uuid.uuid4().hex[:20]}")
+                                req_id=f"cmpl-{uuid.uuid4().hex[:20]}",
+                                timeout_s=timeout_s)
                         except ValueError:
                             # a rejected submit must not destroy the
                             # prefill result: re-stage for retry/wire
@@ -463,7 +480,8 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             threading.Thread(target=_release, daemon=True,
                              name="pd-release").start()
             return eng.submit(prompt_tokens, params,
-                              req_id=f"cmpl-{uuid.uuid4().hex[:20]}")
+                              req_id=f"cmpl-{uuid.uuid4().hex[:20]}",
+                              timeout_s=timeout_s)
         try:
             with urllib.request.urlopen(f"{url}/pd/kv/{req_id}/meta",
                                         timeout=30) as r:
@@ -476,7 +494,8 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         try:
             req = eng.submit_with_kv_chunked(
                 prompt_tokens, first, meta, plans, params,
-                req_id=f"cmpl-{uuid.uuid4().hex[:20]}")
+                req_id=f"cmpl-{uuid.uuid4().hex[:20]}",
+                timeout_s=timeout_s)
         except ValueError as e:
             self._error(400, str(e))
             return None
@@ -502,7 +521,11 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                 if costs is not None:
                     costs.note_transfer(nbytes, time.monotonic() - t0)
             except Exception as e:
-                ci.set_error(f"chunk pull from {url} failed: {e}")
+                # a puller network error is TRANSIENT: the engine's
+                # retry budget falls back to local recompute instead of
+                # failing the request
+                ci.set_error(f"chunk pull from {url} failed: {e}",
+                             transient=True)
                 eng._wake.set()
 
         threading.Thread(target=pull, daemon=True,
@@ -516,9 +539,16 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         body = self._read_body()
         if body is None:
             return
-        if not st.limiter.admit(st.engine.num_waiting):
+        shed = st.limiter.shed_reason(st.engine)
+        if shed is not None:
             st.metrics.requests_rejected.inc()
-            self._error(429, "engine queue full, retry later", "rate_limit_error")
+            st.metrics.requests_shed.inc(reason=shed)
+            retry_after = st.limiter.retry_after_s(st.engine)
+            self._error(429,
+                        "engine queue full, retry later" if shed == "queue_full"
+                        else "KV page pool saturated, retry later",
+                        "rate_limit_error",
+                        headers={"Retry-After": retry_after})
             return
 
         try:
@@ -615,6 +645,13 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                 # generation lengths with ignore_eos
                 ignore_eos=bool(body.get("ignore_eos", False)),
             )
+            # per-request deadline (seconds); 0/absent falls back to the
+            # server default (cfg.request_timeout_s).  Expired requests
+            # are aborted with a 408-style structured error before (or
+            # while) consuming TPU time.
+            timeout_s = float(body.get("timeout", 0) or 0)
+            if timeout_s < 0:
+                return self._error(400, "'timeout' must be >= 0")
         except (TypeError, ValueError) as e:
             return self._error(400, f"bad parameter: {e}")
 
@@ -662,14 +699,15 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                 params, seed=int(uuid.uuid4().hex[:8], 16) | 1)
         try:
             if kv_src:
-                req = self._submit_with_transfer(kv_src, params)
+                req = self._submit_with_transfer(kv_src, params,
+                                                 timeout_s=timeout_s)
                 if req is None:
                     return  # error already sent
                 tokens = req.prompt_tokens
             else:
                 req = st.engine.submit(tokens, params,
                                        req_id=f"cmpl-{uuid.uuid4().hex[:20]}",
-                                       adapter=adapter)
+                                       adapter=adapter, timeout_s=timeout_s)
         except ValueError as e:
             return self._error(400, str(e))
 
@@ -684,7 +722,7 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             try:
                 extra_reqs.append(st.engine.submit(
                     tokens, p_i, req_id=f"{req.req_id}-{ci}",
-                    adapter=adapter))
+                    adapter=adapter, timeout_s=timeout_s))
             except ValueError as e:
                 for r in [req] + extra_reqs:
                     st.engine.abort(r)
@@ -748,8 +786,17 @@ class OpenAIHandler(BaseHTTPRequestHandler):
 
         choices = []
         total_completion = 0
-        for idx, r in enumerate([req] + extra_reqs):
-            out_ids = list(r.stream())
+        all_reqs = [req] + extra_reqs
+        outs = [list(r.stream()) for r in all_reqs]   # drain every choice
+        if any(r.finish_reason in ("error", "deadline") for r in all_reqs):
+            # request-scoped failure or deadline abort: surface the
+            # structured engine error (408/5xx) instead of a 200 with
+            # silently truncated text
+            bad = next(r for r in all_reqs
+                       if r.finish_reason in ("error", "deadline"))
+            st.metrics.observe_request(req)
+            return self._request_error(bad)
+        for idx, (r, out_ids) in enumerate(zip(all_reqs, outs)):
             total_completion += len(out_ids)
             text = st.engine.tokenizer.decode(out_ids)
             finish = r.finish_reason or "stop"
@@ -1030,6 +1077,17 @@ def main(argv=None):
                     help="prompt-lookup speculative decoding: propose up "
                          "to N tokens per step (0 = off; exact greedy "
                          "equivalence)")
+    ap.add_argument("--request-timeout-s", type=float, default=0.0,
+                    help="server-default request deadline in seconds "
+                         "(0 = none); expired requests get 408-style "
+                         "errors before consuming TPU time")
+    ap.add_argument("--kv-shed-threshold", type=float, default=0.0,
+                    help="shed new requests with 429 + Retry-After when "
+                         "KV page usage crosses this fraction while a "
+                         "queue exists (0 = off)")
+    ap.add_argument("--kv-import-retries", type=int, default=1,
+                    help="transient KV-transfer failures fall back to "
+                         "local recompute this many times per request")
     args = ap.parse_args(argv)
 
     import jax
@@ -1065,6 +1123,9 @@ def main(argv=None):
         max_queue_len=args.max_queue_len,
         max_pages=args.max_pages,
         speculative_ngram=args.speculative_ngram,
+        request_timeout_s=args.request_timeout_s,
+        kv_shed_threshold=args.kv_shed_threshold,
+        kv_import_retries=args.kv_import_retries,
     )
     if args.kaito_config_file:
         cfg = load_config_file(cfg, args.kaito_config_file)

@@ -10,10 +10,22 @@ single-node deployments: each backend is a fully independent engine
 server (its own process, its own devices), and requests — including
 SSE streams — relay byte-for-byte.
 
-Scheduling is round-robin with health-aware skip: a backend that
-refuses the connection is marked down and retried on a cool-down, so a
-dead replica costs one skipped turn, not a failed request (behavior the
-dp-over-2-procs test pins).
+Failure-domain design (docs/failure-domains.md):
+
+- Each backend carries a **circuit breaker**: consecutive connect
+  failures open it with exponentially-backed-off cooldowns (capped);
+  when the cooldown lapses the breaker is **half-open** — the next
+  request probes it, and one success closes it again (``mark_up``).
+- **Health probes**: an optional background thread GETs ``/health`` per
+  backend, closing breakers as replicas recover without spending a
+  client request on the probe.
+- **Retry with jittered backoff**: idempotent requests (GET/DELETE and
+  the stateless POST inference routes) retry against alternate replicas
+  — across backends immediately, and across full cycles after a
+  jittered sleep — as long as no response byte has reached the client.
+- **Graceful drain**: SIGTERM stops accepting (503 + Retry-After),
+  lets in-flight relays finish, then exits — the InferenceSet
+  rolling-update contract.
 """
 
 from __future__ import annotations
@@ -22,19 +34,46 @@ import argparse
 import http.client
 import json
 import logging
+import random
+import signal
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from kaito_tpu.utils.failpoints import FAILPOINTS, FailpointError
+
 logger = logging.getLogger(__name__)
 
 DOWN_COOLDOWN_S = 5.0
+DOWN_COOLDOWN_MAX_S = 60.0
+BREAKER_THRESHOLD = 3          # consecutive failures that OPEN the breaker
+RETRY_CYCLES = 2               # full passes over the backend list
+RETRY_BACKOFF_S = 0.1          # jittered sleep between cycles
 HOP_HEADERS = {"connection", "keep-alive", "transfer-encoding",
                "te", "trailer", "upgrade", "proxy-authorization"}
+# POST routes that are safe to replay against another replica before any
+# response byte: stateless inference (any replica computes the same
+# answer).  PD side-channel routes mutate per-replica staging state and
+# must NOT fail over blindly.
+IDEMPOTENT_POST_PREFIXES = ("/v1/completions", "/v1/chat/completions",
+                            "/v1/embeddings", "/score", "/tokenize",
+                            "/detokenize")
 
 
 class _Backend:
+    """One replica plus its circuit-breaker state.
+
+    ``down_until`` stays THE open-until timestamp (tests poke it to
+    heal a backend); ``failures`` counts CONSECUTIVE connect failures.
+    State is derived, never stored:
+
+    - ``open``      — cooling down (``down_until`` in the future)
+    - ``half-open`` — cooldown lapsed but the breaker tripped and no
+      success has closed it yet (the next request is the probe)
+    - ``closed``    — healthy
+    """
+
     def __init__(self, url: str):
         url = url.rstrip("/")
         assert url.startswith("http://"), f"http backends only: {url}"
@@ -44,13 +83,34 @@ class _Backend:
         self.port = int(port or 80)
         self.down_until = 0.0
         self.served = 0
+        self.failures = 0
 
     @property
     def alive(self) -> bool:
         return time.monotonic() >= self.down_until
 
+    @property
+    def state(self) -> str:
+        if not self.alive:
+            return "open"
+        if self.failures >= BREAKER_THRESHOLD:
+            return "half-open"
+        return "closed"
+
     def mark_down(self) -> None:
-        self.down_until = time.monotonic() + DOWN_COOLDOWN_S
+        """One more consecutive failure: cool down with exponential
+        backoff (capped) so a dead replica is probed ever less often
+        while it stays dead."""
+        self.failures += 1
+        backoff = min(DOWN_COOLDOWN_S * (2 ** max(0, self.failures
+                                                  - BREAKER_THRESHOLD)),
+                      DOWN_COOLDOWN_MAX_S)
+        self.down_until = time.monotonic() + backoff
+
+    def mark_up(self) -> None:
+        """A success (request or health probe) closes the breaker."""
+        self.failures = 0
+        self.down_until = 0.0
 
 
 class DPRouter:
@@ -62,6 +122,8 @@ class DPRouter:
         self.backends = [_Backend(u) for u in backends]
         self._rr = 0
         self._lock = threading.Lock()
+        self.draining = False
+        self._inflight = 0
 
     def next_backend(self) -> Optional[_Backend]:
         """Next live backend (round robin), or the next one regardless
@@ -82,64 +144,202 @@ class DPRouter:
 
     def stats(self) -> dict:
         with self._lock:
-            return {b.url: {"served": b.served, "alive": b.alive}
+            return {b.url: {"served": b.served, "alive": b.alive,
+                            "state": b.state, "failures": b.failures}
                     for b in self.backends}
+
+    # -- drain bookkeeping -------------------------------------------------
+    def begin_request(self) -> bool:
+        """Admission gate: False while draining (caller answers 503)."""
+        with self._lock:
+            if self.draining:
+                return False
+            self._inflight += 1
+            return True
+
+    def end_request(self) -> None:
+        with self._lock:
+            self._inflight -= 1
+
+    @property
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Stop accepting, wait for in-flight relays to finish.  Returns
+        True when the router went quiet inside the timeout."""
+        with self._lock:
+            self.draining = True
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.inflight == 0:
+                return True
+            time.sleep(0.05)
+        return self.inflight == 0
+
+
+class HealthProber(threading.Thread):
+    """Background ``/health`` probe per backend: closes breakers as
+    replicas recover, opens them when a live-looking backend refuses
+    the probe — without spending client requests on discovery."""
+
+    def __init__(self, router: DPRouter, interval_s: float = 2.0):
+        super().__init__(daemon=True, name="dp-health-prober")
+        self.router = router
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            for b in self.router.backends:
+                try:
+                    conn = http.client.HTTPConnection(b.host, b.port,
+                                                      timeout=5)
+                    try:
+                        conn.request("GET", "/health")
+                        ok = conn.getresponse().status == 200
+                    finally:
+                        conn.close()
+                except (ConnectionError, OSError):
+                    ok = False
+                if ok:
+                    if b.failures:
+                        logger.info("health probe: %s recovered", b.url)
+                    b.mark_up()
+                elif b.alive:
+                    b.mark_down()
+
+
+def _retryable(method: str, path: str) -> bool:
+    """May this request be replayed against another replica (before any
+    response byte)?  GET/DELETE always; POST only on the stateless
+    inference routes."""
+    if method in ("GET", "DELETE", "HEAD"):
+        return True
+    if method == "POST":
+        return any(path.startswith(p) for p in IDEMPOTENT_POST_PREFIXES)
+    return False
 
 
 def make_router_server(router: DPRouter, host: str = "0.0.0.0",
-                       port: int = 0) -> ThreadingHTTPServer:
+                       port: int = 0,
+                       probe_interval_s: float = 0.0) -> ThreadingHTTPServer:
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
         def log_message(self, *a):
             pass
 
+        def _send_json(self, code: int, obj: dict,
+                       headers: Optional[dict] = None) -> None:
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, str(v))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _read_request_body(self) -> Optional[bytes]:
+            """Read the client body whichever way it was framed.  A
+            ``Transfer-Encoding: chunked`` body is DE-CHUNKED here and
+            forwarded with Content-Length (http.client sets it), so a
+            chunked client upload is no longer silently dropped."""
+            te = (self.headers.get("Transfer-Encoding") or "").lower()
+            if "chunked" in te:
+                chunks = []
+                while True:
+                    size_line = self.rfile.readline(65536).strip()
+                    size = int(size_line.split(b";")[0] or b"0", 16)
+                    if size == 0:
+                        # consume trailers until the blank line
+                        while self.rfile.readline(65536).strip():
+                            pass
+                        break
+                    chunks.append(self.rfile.read(size))
+                    self.rfile.read(2)          # CRLF after each chunk
+                return b"".join(chunks)
+            length = int(self.headers.get("Content-Length") or 0)
+            return self.rfile.read(length) if length else None
+
         def _relay(self, method: str):
             if self.path == "/router/stats":
-                body = json.dumps(router.stats()).encode()
-                self.send_response(200)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                self._send_json(200, router.stats())
                 return
-            length = int(self.headers.get("Content-Length") or 0)
-            body = self.rfile.read(length) if length else None
+            if not router.begin_request():
+                self._send_json(503, {"error": "router draining"},
+                                headers={"Retry-After": 1})
+                return
+            try:
+                self._relay_inner(method)
+            finally:
+                router.end_request()
+
+        def _relay_inner(self, method: str):
+            try:
+                body = self._read_request_body()
+            except (ValueError, ConnectionError, OSError):
+                self._send_json(400, {"error": "malformed request body"})
+                return
             # failover is only safe BEFORE the first response byte: a
             # backend that dies mid-stream cannot be retried without
             # corrupting the client's half-written reply (and without
-            # re-running the inference) — abort the connection instead
-            tried = 0
-            while tried < len(router.backends):
-                b = router.next_backend()
-                tried += 1
-                try:
-                    resp, conn = self._connect(b, method, body)
-                except (ConnectionError, OSError) as e:
-                    logger.warning("backend %s unreachable (%s); skipping",
-                                   b.url, e)
-                    b.mark_down()
-                    continue
-                self._stream_response(b, resp, conn)
-                return
-            self.send_response(503)
-            msg = b'{"error": "no live backend"}'
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(msg)))
-            self.end_headers()
-            self.wfile.write(msg)
+            # re-running the inference) — abort the connection instead.
+            # Retryable requests get RETRY_CYCLES full passes over the
+            # list with a jittered backoff between passes; one-shot
+            # (non-idempotent) requests get a single pass.
+            retryable = _retryable(method, self.path)
+            cycles = RETRY_CYCLES if retryable else 1
+            last_status: Optional[int] = None
+            for cycle in range(cycles):
+                if cycle:
+                    time.sleep(RETRY_BACKOFF_S * (1 + random.random()))
+                tried = 0
+                while tried < len(router.backends):
+                    b = router.next_backend()
+                    tried += 1
+                    try:
+                        resp, conn = self._connect(b, method, body)
+                    except (ConnectionError, OSError, FailpointError) as e:
+                        logger.warning("backend %s unreachable (%s); "
+                                       "skipping", b.url, e)
+                        b.mark_down()
+                        continue
+                    if retryable and resp.status in (502, 503) \
+                            and (cycle + 1 < cycles
+                                 or tried < len(router.backends)):
+                        # the replica answered but cannot serve (loading
+                        # stub, drain, overload): try elsewhere.  The
+                        # breaker does NOT trip — the process is alive.
+                        last_status = resp.status
+                        conn.close()
+                        continue
+                    b.mark_up()
+                    self._stream_response(b, method, resp, conn)
+                    return
+            self._send_json(503 if last_status is None else last_status,
+                            {"error": "no live backend"},
+                            headers={"Retry-After": 1})
 
         def _connect(self, b: _Backend, method: str,
                      body: Optional[bytes]):
             """Send the request and read the response HEAD; raises are
             retryable (nothing has reached the client yet)."""
+            FAILPOINTS.fire("router.forward", backend=b.url)
             conn = http.client.HTTPConnection(b.host, b.port, timeout=600)
             headers = {k: v for k, v in self.headers.items()
-                       if k.lower() not in HOP_HEADERS}
+                       if k.lower() not in HOP_HEADERS
+                       and k.lower() != "content-length"}
             conn.request(method, self.path, body=body, headers=headers)
             return conn.getresponse(), conn
 
-        def _stream_response(self, b: _Backend, resp, conn) -> None:
+        def _stream_response(self, b: _Backend, method: str, resp,
+                             conn) -> None:
             """Relay an already-open backend response.  A BACKEND read
             failure marks it down and aborts the client connection (no
             retry — bytes are already out); a CLIENT write failure just
@@ -149,11 +349,18 @@ def make_router_server(router: DPRouter, host: str = "0.0.0.0",
                 for k, v in resp.getheaders():
                     if k.lower() not in HOP_HEADERS:
                         self.send_header(k, v)
+                # 1xx/204/304 (and HEAD replies) carry NO body by spec:
+                # chunked framing (or a terminator) after their headers
+                # would corrupt the connection for the next request
+                bodyless = (resp.status < 200 or resp.status in (204, 304)
+                            or method == "HEAD")
                 has_len = resp.getheader("Content-Length") is not None
-                if not has_len:
+                if not has_len and not bodyless:
                     # stream of unknown length (SSE): relay chunked
                     self.send_header("Transfer-Encoding", "chunked")
                 self.end_headers()
+                if bodyless:
+                    return
                 # relay bytes AS THEY ARRIVE so SSE tokens stream through
                 while True:
                     try:
@@ -195,7 +402,13 @@ def make_router_server(router: DPRouter, host: str = "0.0.0.0",
         def do_DELETE(self):
             self._relay("DELETE")
 
-    return ThreadingHTTPServer((host, port), Handler)
+    srv = ThreadingHTTPServer((host, port), Handler)
+    srv.router = router                      # type: ignore[attr-defined]
+    if probe_interval_s > 0:
+        prober = HealthProber(router, probe_interval_s)
+        prober.start()
+        srv.prober = prober                  # type: ignore[attr-defined]
+    return srv
 
 
 def main(argv=None):
@@ -204,12 +417,32 @@ def main(argv=None):
                     help="backend base URL (repeat per replica)")
     ap.add_argument("--port", type=int, default=5000)
     ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--health-probe-interval-s", type=float, default=2.0,
+                    help="per-backend /health probe cadence (0 = off)")
+    ap.add_argument("--drain-timeout-s", type=float, default=30.0,
+                    help="SIGTERM grace: max seconds to finish in-flight "
+                         "requests before exit")
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
-    srv = make_router_server(DPRouter(args.backend), args.host, args.port)
+    router = DPRouter(args.backend)
+    srv = make_router_server(router, args.host, args.port,
+                             probe_interval_s=args.health_probe_interval_s)
+
+    def _term(signum, frame):
+        # graceful drain: stop accepting, finish in-flight, exit — the
+        # rolling-update contract (new requests get 503 + Retry-After,
+        # the Gateway retries them on another replica)
+        logger.info("SIGTERM: draining %d in-flight request(s)",
+                    router.inflight)
+        threading.Thread(target=lambda: (router.drain(args.drain_timeout_s),
+                                         srv.shutdown()),
+                         daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _term)
     logger.info("dp router on :%d -> %s", srv.server_address[1],
                 args.backend)
     srv.serve_forever()
+    logger.info("dp router exited cleanly")
 
 
 if __name__ == "__main__":
