@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: all native unit-test unit-test-fast unit-test-slow engine-test rag-test chaos bench serve manager clean
+.PHONY: all native unit-test unit-test-fast unit-test-slow engine-test rag-test chaos obs bench serve manager clean
 
 all: native
 
@@ -33,6 +33,13 @@ rag-test:
 # engine containment tests
 chaos:
 	$(PYTHON) -m pytest tests/test_failpoints.py -q
+
+# observability suite (docs/observability.md): tracing, flight
+# recorder, router metrics, exposition-format invariants — fast tier
+# only (the slow e2e legs run under unit-test / unit-test-slow)
+obs:
+	$(PYTHON) -m pytest tests/test_tracing.py tests/test_metrics_format.py \
+	  -q -m "not slow"
 
 bench:
 	$(PYTHON) bench.py

@@ -84,6 +84,11 @@ class EngineConfig:
     # usage crosses this fraction while a queue exists (0 = off)
     kv_import_retries: int = 1           # transient KV-transfer failures fall
     # back to local recompute this many times before failing the request
+    # observability (docs/observability.md)
+    slow_request_threshold_s: float = 0.0  # dump a request's span tree to the
+    # log when its end-to-end latency crosses this (0 = off)
+    trace_capacity: int = 8192           # span ring-buffer entries
+    timeline_capacity: int = 4096        # step flight-recorder entries
 
     def replace(self, **kw) -> "EngineConfig":
         return dataclasses.replace(self, **kw)

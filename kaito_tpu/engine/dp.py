@@ -92,6 +92,16 @@ class DataParallelEngine:
         self.host_kv = (_AggregateHostKV(self.engines)
                         if any(e.host_kv is not None for e in self.engines)
                         else None)
+        # one histogram family across groups: every group's scheduler
+        # observes into the SAME (thread-safe) series, so /metrics
+        # exposes one kaito:engine_step_seconds for the whole pod.
+        # Tracers/timelines stay per-group — the server's /debug/trace
+        # and /debug/timeline merge across `self.engines`.
+        for e in self.engines[1:]:
+            e.step_hist = first.step_hist
+            e.queue_wait_hist = first.queue_wait_hist
+        self.step_hist = first.step_hist
+        self.queue_wait_hist = first.queue_wait_hist
         self._rr = 0
         self._lock = threading.Lock()
         logger.info("data-parallel serving: %d groups x %d device(s)",
@@ -142,12 +152,14 @@ class DataParallelEngine:
     def submit(self, prompt_tokens, params: SamplingParams,
                req_id: Optional[str] = None, export_kv: bool = False,
                adapter: str = "",
-               timeout_s: Optional[float] = None) -> Request:
+               timeout_s: Optional[float] = None,
+               trace_id: Optional[str] = None) -> Request:
         if export_kv:
             raise RuntimeError("P/D KV export requires data_parallel=1")
         eng = self._pick()
         req = eng.submit(prompt_tokens, params, req_id=req_id,
-                         adapter=adapter, timeout_s=timeout_s)
+                         adapter=adapter, timeout_s=timeout_s,
+                         trace_id=trace_id)
         req._dp_group = eng
         return req
 

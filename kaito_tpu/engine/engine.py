@@ -46,6 +46,7 @@ from kaito_tpu.estimator.estimator import PER_CHIP_OVERHEAD_BYTES, HBM_UTILIZATI
 from kaito_tpu.models.metadata import ModelMetadata
 from kaito_tpu.models.registry import get_model_by_name
 from kaito_tpu.utils.failpoints import FAILPOINTS
+from kaito_tpu.utils.tracing import RingTracer, StepTimeline, format_span_tree
 
 logger = logging.getLogger(__name__)
 
@@ -122,6 +123,10 @@ class Request:
     deadline: Optional[float] = None
     error: Optional[dict] = None
     kv_retries: int = 0
+    # end-to-end trace identity (X-Request-Id): distinct from req_id so
+    # a client-supplied id can never collide with engine-internal keys
+    # (kv_exports, host_kv); defaults to req_id at submit
+    trace_id: str = ""
 
     @property
     def expired(self) -> bool:
@@ -431,9 +436,30 @@ class InferenceEngine:
             "requests_expired_total": 0,      # deadline-aborted (408)
             "kv_import_retries_total": 0,     # transient -> local recompute
             "engine_fatal_total": 0,          # _fail_all escalations
+            # observability (docs/observability.md)
+            "prefill_tokens_total": 0,        # prefill tokens dispatched
+            "requests_shed_total": 0,         # 429s (bumped by the server)
         }
         self._last_deadline_sweep = 0.0
         self._last_export_tick = 0.0
+
+        # tracing + flight recorder (docs/observability.md): bounded,
+        # always on — recording is a deque append, scrapes snapshot
+        from kaito_tpu.engine.metrics import Histogram
+
+        self.tracer = RingTracer(cfg.trace_capacity)
+        self.timeline = StepTimeline(cfg.timeline_capacity)
+        # registry=None: the server's EngineMetrics registry adopts
+        # these at construction so /metrics exposes them
+        self.step_hist = Histogram(
+            "kaito:engine_step_seconds", "Scheduler step wall time", None,
+            buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                     0.1, 0.25, 0.5, 1.0, 2.5))
+        self.queue_wait_hist = Histogram(
+            "kaito:queue_wait_seconds",
+            "Submit-to-admission queue wait", None,
+            buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                     0.5, 1.0, 2.5, 5.0, 10.0, 30.0))
 
         self._decode_fn = self._build_decode_fn()
         self._prefill_fns: dict[int, object] = {}
@@ -1113,14 +1139,17 @@ class InferenceEngine:
     def submit(self, prompt_tokens: list[int], params: SamplingParams,
                req_id: Optional[str] = None,
                export_kv: bool = False, adapter: str = "",
-               timeout_s: Optional[float] = None) -> Request:
+               timeout_s: Optional[float] = None,
+               trace_id: Optional[str] = None) -> Request:
         self._validate_submit(prompt_tokens, params)
         if adapter and adapter not in self.adapter_index:
             raise ValueError(f"unknown adapter {adapter!r}")
-        req = Request(req_id or f"req-{self.counters['requests_total']}",
+        rid = req_id or f"req-{self.counters['requests_total']}"
+        req = Request(rid,
                       list(prompt_tokens), params, export_kv=export_kv,
                       adapter=adapter,
-                      deadline=self._deadline_for(timeout_s))
+                      deadline=self._deadline_for(timeout_s),
+                      trace_id=trace_id or rid)
         with self._lock:
             self.counters["requests_total"] += 1
             self._waiting_count += 1
@@ -1132,15 +1161,18 @@ class InferenceEngine:
                        meta: dict, payload: bytes,
                        params: SamplingParams,
                        req_id: Optional[str] = None,
-                       timeout_s: Optional[float] = None) -> Request:
+                       timeout_s: Optional[float] = None,
+                       trace_id: Optional[str] = None) -> Request:
         """Decode-role entry: continue a prefilled request from
         transferred KV pages."""
         self._validate_submit(prompt_tokens, params)
         self._validate_kv_meta(meta, len(prompt_tokens))
-        req = Request(req_id or f"pd-{self.counters['requests_total']}",
+        rid = req_id or f"pd-{self.counters['requests_total']}"
+        req = Request(rid,
                       list(prompt_tokens), params,
                       kv_import=(meta, payload, first_token),
-                      deadline=self._deadline_for(timeout_s))
+                      deadline=self._deadline_for(timeout_s),
+                      trace_id=trace_id or meta.get("trace_id") or rid)
         with self._lock:
             self.counters["requests_total"] += 1
             self._waiting_count += 1
@@ -1152,7 +1184,8 @@ class InferenceEngine:
                               first_token: int, meta: dict, slabs,
                               params: SamplingParams,
                               req_id: Optional[str] = None,
-                              timeout_s: Optional[float] = None) -> Request:
+                              timeout_s: Optional[float] = None,
+                              trace_id: Optional[str] = None) -> Request:
         """Colocated decode entry: the prefill engine lives in THIS
         process, so its staged canonical KV slab hands off as a single
         device-to-device scatter — no host bounce, no wire (the
@@ -1166,10 +1199,12 @@ class InferenceEngine:
         # loop (or, worse, decode silently against misaligned KV when
         # the page counts happen to match)
         self._validate_kv_meta(meta, len(prompt_tokens), strict_shape=True)
-        req = Request(req_id or f"pd-{self.counters['requests_total']}",
+        rid = req_id or f"pd-{self.counters['requests_total']}"
+        req = Request(rid,
                       list(prompt_tokens), params,
                       kv_device=(meta, slabs, first_token),
-                      deadline=self._deadline_for(timeout_s))
+                      deadline=self._deadline_for(timeout_s),
+                      trace_id=trace_id or meta.get("trace_id") or rid)
         with self._lock:
             self.counters["requests_total"] += 1
             self._waiting_count += 1
@@ -1182,7 +1217,8 @@ class InferenceEngine:
                                params: SamplingParams,
                                req_id: Optional[str] = None,
                                deadline_s: float = 120.0,
-                               timeout_s: Optional[float] = None):
+                               timeout_s: Optional[float] = None,
+                               trace_id: Optional[str] = None):
         """Decode-role entry for the CHUNKED transfer path: the request
         is admitted immediately and its KV chunks are scattered by the
         scheduler loop as the caller ``feed``s them into the returned
@@ -1193,12 +1229,14 @@ class InferenceEngine:
 
         self._validate_submit(prompt_tokens, params)
         self._validate_kv_meta(meta, len(prompt_tokens))
-        req = Request(req_id or f"pd-{self.counters['requests_total']}",
+        rid = req_id or f"pd-{self.counters['requests_total']}"
+        req = Request(rid,
                       list(prompt_tokens), params,
                       kv_chunked=ChunkedImport(meta, list(plans), first_token,
                                                deadline_s=deadline_s),
                       deadline=self._deadline_for(timeout_s),
-                      kv_retries=max(0, self.cfg.kv_import_retries))
+                      kv_retries=max(0, self.cfg.kv_import_retries),
+                      trace_id=trace_id or meta.get("trace_id") or rid)
         with self._lock:
             self.counters["requests_total"] += 1
             self._waiting_count += 1
@@ -1343,6 +1381,7 @@ class InferenceEngine:
         if self.host_kv is not None:
             self.host_kv.discard(req.req_id)
         self.counters["requests_failed_total"] += 1
+        self._finish_trace(req)
         req.out.put(None)
 
     def _expire_request(self, req: Request):
@@ -1357,7 +1396,30 @@ class InferenceEngine:
         if self.host_kv is not None:
             self.host_kv.discard(req.req_id)
         self.counters["requests_expired_total"] += 1
+        self._finish_trace(req)
         req.out.put(None)
+
+    def _finish_trace(self, req: Request) -> None:
+        """Record the request's decode + end-to-end spans and, when it
+        crossed ``--slow-request-threshold-s``, dump its span tree to
+        the log (the on-call entry point into /debug/trace)."""
+        end = req.finish_time or time.monotonic()
+        if req.first_token_time is not None:
+            self.tracer.record("decode", req.trace_id,
+                               req.first_token_time,
+                               end - req.first_token_time,
+                               tokens=len(req.output_tokens))
+        self.tracer.record("request", req.trace_id, req.submit_time,
+                           end - req.submit_time, req_id=req.req_id,
+                           finish=req.finish_reason or "stop",
+                           preemptions=req.preemptions)
+        thr = self.cfg.slow_request_threshold_s
+        if thr and end - req.submit_time >= thr:
+            logger.warning(
+                "slow request %s (trace %s): %.3fs e2e >= %.3fs "
+                "threshold\n%s", req.req_id, req.trace_id,
+                end - req.submit_time, thr,
+                format_span_tree(self.tracer.spans(req.trace_id)))
 
     def _expire_deadlines(self) -> bool:
         """Sweep expired requests out of the waiting queue and the
@@ -1456,7 +1518,40 @@ class InferenceEngine:
     def step(self) -> bool:
         """One scheduler iteration. Returns False when idle.
 
-        Decode-priority scheduling: every iteration with active slots
+        Wraps the actual scheduling (``_step_inner``) with the flight
+        recorder: every non-idle iteration appends one bounded timeline
+        record (wall time, batch shape, token mix, KV pressure,
+        preemption/shed/expiry deltas) and observes
+        ``kaito:engine_step_seconds``.  Idle polls are not recorded —
+        they would drown the signal and the histogram alike.
+        """
+        c = self.counters
+        before = (c["prefill_steps_total"], c["decode_steps_total"],
+                  c["generation_tokens_total"], c["prefill_tokens_total"],
+                  c["preemptions_total"], c["requests_expired_total"],
+                  c["requests_shed_total"])
+        t0 = time.monotonic()
+        did = self._step_inner()
+        if did:
+            wall = time.monotonic() - t0
+            self.step_hist.observe(wall)
+            self.timeline.add(
+                t0, wall,
+                running=self.num_running,
+                waiting=self._waiting_count,
+                prefill_steps=c["prefill_steps_total"] - before[0],
+                decode_steps=c["decode_steps_total"] - before[1],
+                decode_tokens=c["generation_tokens_total"] - before[2],
+                prefill_tokens=c["prefill_tokens_total"] - before[3],
+                preemptions=c["preemptions_total"] - before[4],
+                expired=c["requests_expired_total"] - before[5],
+                shed=c["requests_shed_total"] - before[6],
+                kv_pages_used=(self.allocator.num_pages - 1
+                               - self.allocator.available))
+        return did
+
+    def _step_inner(self) -> bool:
+        """Decode-priority scheduling: every iteration with active slots
         runs one decode step; prefill advances one bounded chunk every
         ``prefill_interleave`` iterations (every iteration when nothing
         is decoding), so a running batch keeps its token cadence while
@@ -1563,6 +1658,7 @@ class InferenceEngine:
         reserved here; decode grows the page list page-by-page, with
         preemption when the pool runs dry.
         """
+        t_adm = time.monotonic()
         tokens = req.resume_tokens()
         n = len(tokens)
         cached = 0
@@ -1617,6 +1713,16 @@ class InferenceEngine:
         slot.prefilling = True
         slot.prefill_pos = cached
         slot.prefill_tokens = tokens
+        now = time.monotonic()
+        # queue wait only on FIRST admission — a resume after preemption
+        # would re-count the whole lifetime as queue time
+        if req.first_token_time is None and not req.preemptions:
+            self.queue_wait_hist.observe(now - req.submit_time)
+            self.tracer.record("queue.wait", req.trace_id, req.submit_time,
+                               now - req.submit_time)
+        self.tracer.record("admit", req.trace_id, t_adm, now - t_adm,
+                           slot=free_slot, cached_tokens=cached,
+                           pages=len(pages), resume=req.preemptions)
         try:
             self.sampling = self.sampling.set_slot(
                 free_slot, temperature=req.params.temperature,
@@ -1673,8 +1779,10 @@ class InferenceEngine:
         n = len(req.prompt_tokens)
         n_prompt_pages = -(-n // self.cfg.page_size)
         slot = self.slots[free_slot]
-        self.cache = import_kv(self.cache, slot.pages[:n_prompt_pages],
-                               payload, meta)
+        with self.tracer.span("kv.import", req.trace_id,
+                              bytes=len(payload), pages=n_prompt_pages):
+            self.cache = import_kv(self.cache, slot.pages[:n_prompt_pages],
+                                   payload, meta)
         if not req.prompt_counted:
             self.counters["prompt_tokens_total"] += n
             req.prompt_counted = True
@@ -1690,9 +1798,11 @@ class InferenceEngine:
         n = len(req.prompt_tokens)
         n_prompt_pages = -(-n // self.cfg.page_size)
         slot = self.slots[free_slot]
-        self.cache = import_arrays(self.cache,
-                                   slot.pages[:n_prompt_pages],
-                                   k_dev, v_dev)
+        with self.tracer.span("kv.import.device", req.trace_id,
+                              pages=n_prompt_pages):
+            self.cache = import_arrays(self.cache,
+                                       slot.pages[:n_prompt_pages],
+                                       k_dev, v_dev)
         # drop the slab references (unpin HBM) but KEEP the field as a
         # marker: _evict_slot reads it to keep imported pages out of
         # the shared prefix tree, like the other import kinds
@@ -1737,9 +1847,11 @@ class InferenceEngine:
                     if ci.complete:
                         n = len(req.prompt_tokens)
                         n_pages = -(-n // self.cfg.page_size)
-                        k, v = ci.full_arrays()
-                        self.cache = import_arrays(
-                            self.cache, slot.pages[:n_pages], k, v)
+                        with self.tracer.span("kv.import.chunked",
+                                              req.trace_id, pages=n_pages):
+                            k, v = ci.full_arrays()
+                            self.cache = import_arrays(
+                                self.cache, slot.pages[:n_pages], k, v)
                         slot.importing = False
                         self._begin_decode(i, ci.first_token, n)
                         did = True
@@ -1840,6 +1952,10 @@ class InferenceEngine:
             self._recover_cache_if_poisoned()
             return True
         self.counters["prefill_steps_total"] += 1
+        self.counters["prefill_tokens_total"] += m
+        self.tracer.record("prefill.chunk", req.trace_id, t_first_chunk,
+                           time.monotonic() - t_first_chunk, pos=pos,
+                           tokens=m, bucket=bucket, slot=i, cp=bool(use_cp))
         if not slot.prefill_t0:
             slot.prefill_t0 = t_first_chunk
             slot.prefill_base = pos
@@ -1939,6 +2055,8 @@ class InferenceEngine:
             self._spill_slot(victim)
         req.preemptions += 1
         self.counters["preemptions_total"] += 1
+        self.tracer.record("preempt", req.trace_id, time.monotonic(), 0.0,
+                           slot=victim)
         # evict BEFORE clearing kv_import so imported (foreign) KV pages
         # release uncommitted — they must never enter the radix tree
         self._evict_slot(victim, commit=True)
@@ -1951,6 +2069,7 @@ class InferenceEngine:
             # its tokens were emitted — finish it at the length cap
             req.finish_reason = "length"
             req.finish_time = time.monotonic()
+            self._finish_trace(req)
             req.out.put(None)
             self.counters["requests_finished_total"] += 1
             return
@@ -1980,11 +2099,14 @@ class InferenceEngine:
         page_axis = 2 if self.pp_exec is not None else 1
         try:
             FAILPOINTS.fire("engine.spill", req_id=req.req_id)
-            k_pages, v_pages = gather_pages(
-                self.cache.k, self.cache.v, jnp.asarray(ids),
-                page_axis=page_axis)
-            if self.host_kv.put(req.req_id, k_pages, v_pages, written,
-                                page_axis=page_axis):
+            with self.tracer.span("kv.spill", req.trace_id,
+                                  pages=n_pages):
+                k_pages, v_pages = gather_pages(
+                    self.cache.k, self.cache.v, jnp.asarray(ids),
+                    page_axis=page_axis)
+                stored = self.host_kv.put(req.req_id, k_pages, v_pages,
+                                          written, page_axis=page_axis)
+            if stored:
                 self.counters["host_kv_spilled_pages_total"] += n_pages
             # else: entry can never fit; resume recomputes
         except Exception:
@@ -2035,9 +2157,10 @@ class InferenceEngine:
 
             repl = NamedSharding(mesh, P())
             ids, ek, ev = (jax.device_put(x, repl) for x in (ids, ek, ev))
-        k, v = self._scatter_pages_fn()(self.cache.k, self.cache.v,
-                                        ids, ek, ev)
-        self.cache = KVCache(k=k, v=v)
+        with self.tracer.span("kv.restore", req.trace_id, pages=n_pages):
+            k, v = self._scatter_pages_fn()(self.cache.k, self.cache.v,
+                                            ids, ek, ev)
+            self.cache = KVCache(k=k, v=v)
         self.counters["host_kv_restored_pages_total"] += n_pages
         n = len(req.resume_tokens())
         slot.prefilling = False
@@ -2438,11 +2561,15 @@ class InferenceEngine:
                 # consumer (meta/chunk pull); a COLOCATED decode engine
                 # grabs the device slabs instead and the transfer never
                 # touches the host (the NIXL-device-path analogue)
-                self.kv_exports.put(req.req_id, stage_export(
-                    self.cache, slot.pages[:n_pages], n_tokens=n,
-                    model=self.md.name,
-                    prompt_tokens=list(req.prompt_tokens),
-                    first_token=req.output_tokens[0], lazy_drain=True))
+                with self.tracer.span("kv.export.stage", req.trace_id,
+                                      pages=n_pages):
+                    self.kv_exports.put(req.req_id, stage_export(
+                        self.cache, slot.pages[:n_pages], n_tokens=n,
+                        model=self.md.name,
+                        prompt_tokens=list(req.prompt_tokens),
+                        first_token=req.output_tokens[0], lazy_drain=True,
+                        trace_id=req.trace_id))
+            self._finish_trace(req)
             req.out.put(None)
             if self.host_kv is not None:
                 self.host_kv.discard(req.req_id)

@@ -5,6 +5,10 @@ controller's benchmark probe, the KEDA scaler and the InferencePool EPP
 all scrape :5000/metrics, the way they scrape vLLM's gauges in the
 reference (SURVEY.md §5 "Metrics/logging"; names kept close to vLLM's
 ``vllm:*`` series so dashboards translate mechanically to ``kaito:*``).
+
+Also reused by the DP router (per-backend counters, breaker gauges,
+upstream latency histograms) and the tuning sidecar — see
+docs/observability.md for the full inventory.
 """
 
 from __future__ import annotations
@@ -15,36 +19,49 @@ from typing import Iterable, Mapping, Optional
 
 
 class Counter:
-    def __init__(self, name: str, help_: str, registry: "Registry",
+    def __init__(self, name: str, help_: str, registry: "Optional[Registry]",
                  labels: tuple[str, ...] = ()):
         self.name, self.help = name, help_
         self.label_names = labels
         self._values: dict[tuple, float] = {}
         self._lock = threading.Lock()
-        registry.register(self)
+        if registry is not None:
+            registry.register(self)
 
     def inc(self, amount: float = 1.0, **labels):
-        key = tuple(labels.get(l, "") for l in self.label_names)
+        key = tuple(str(labels.get(l, "")) for l in self.label_names)
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + amount
 
+    def value(self, **labels) -> float:
+        key = tuple(str(labels.get(l, "")) for l in self.label_names)
+        with self._lock:
+            return self._values.get(key, 0.0)
+
     def collect(self) -> Iterable[str]:
+        with self._lock:
+            values = sorted(self._values.items())
         yield f"# HELP {self.name} {self.help}"
         yield f"# TYPE {self.name} counter"
-        if not self._values:
-            yield f"{self.name} 0"
+        if not values:
+            # a labelled family with no samples emits nothing: an
+            # unlabelled `name 0` here would clash with labelled
+            # samples the moment the first one appears
+            if not self.label_names:
+                yield f"{self.name} 0"
             return
-        for key, v in sorted(self._values.items()):
+        for key, v in values:
             yield f"{self.name}{_fmt_labels(self.label_names, key)} {_fmt(v)}"
 
 
 class Gauge:
-    def __init__(self, name: str, help_: str, registry: "Registry",
+    def __init__(self, name: str, help_: str, registry: "Optional[Registry]",
                  fn=None):
         self.name, self.help = name, help_
         self.fn = fn
         self.value = 0.0
-        registry.register(self)
+        if registry is not None:
+            registry.register(self)
 
     def set(self, v: float):
         self.value = float(v)
@@ -60,28 +77,47 @@ class Histogram:
     DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
                        0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
 
-    def __init__(self, name: str, help_: str, registry: "Registry",
-                 buckets: Optional[tuple] = None):
+    def __init__(self, name: str, help_: str, registry: "Optional[Registry]",
+                 buckets: Optional[tuple] = None,
+                 labels: tuple[str, ...] = ()):
         self.name, self.help = name, help_
         self.buckets = tuple(buckets or self.DEFAULT_BUCKETS)
+        self.label_names = labels
+        # aggregate across all label values — `percentile()` and the
+        # unlabelled exposition read these
         self._counts = [0] * (len(self.buckets) + 1)
         self._sum = 0.0
         self._total = 0
+        # label-values tuple -> [counts, sum, total] (labelled families)
+        self._series: dict[tuple, list] = {}
         self._lock = threading.Lock()
-        registry.register(self)
+        if registry is not None:
+            registry.register(self)
 
-    def observe(self, v: float):
+    def observe(self, v: float, **labels):
+        idx = len(self.buckets)
+        for i, b in enumerate(self.buckets):
+            if v <= b:
+                idx = i
+                break
         with self._lock:
             self._sum += v
             self._total += 1
-            for i, b in enumerate(self.buckets):
-                if v <= b:
-                    self._counts[i] += 1
-                    return
-            self._counts[-1] += 1
+            self._counts[idx] += 1
+            if self.label_names:
+                key = tuple(str(labels.get(l, ""))
+                            for l in self.label_names)
+                s = self._series.get(key)
+                if s is None:
+                    s = self._series[key] = [
+                        [0] * (len(self.buckets) + 1), 0.0, 0]
+                s[0][idx] += 1
+                s[1] += v
+                s[2] += 1
 
     def percentile(self, q: float) -> float:
-        """Approximate quantile from bucket counts (upper bound)."""
+        """Approximate quantile from bucket counts (upper bound),
+        aggregated across all label values."""
         with self._lock:
             if not self._total:
                 return 0.0
@@ -93,17 +129,37 @@ class Histogram:
                     return b
             return float("inf")
 
-    def collect(self) -> Iterable[str]:
-        yield f"# HELP {self.name} {self.help}"
-        yield f"# TYPE {self.name} histogram"
+    def _emit_series(self, label_names, label_values, counts, sum_,
+                     total) -> Iterable[str]:
         cum = 0
         for i, b in enumerate(self.buckets):
-            cum += self._counts[i]
-            yield f'{self.name}_bucket{{le="{_fmt(b)}"}} {cum}'
-        cum += self._counts[-1]
-        yield f'{self.name}_bucket{{le="+Inf"}} {cum}'
-        yield f"{self.name}_sum {_fmt(self._sum)}"
-        yield f"{self.name}_count {self._total}"
+            cum += counts[i]
+            lbl = _fmt_labels(label_names + ("le",),
+                              label_values + (_fmt(b),))
+            yield f"{self.name}_bucket{lbl} {cum}"
+        cum += counts[-1]
+        lbl = _fmt_labels(label_names + ("le",), label_values + ("+Inf",))
+        yield f"{self.name}_bucket{lbl} {cum}"
+        lbl = _fmt_labels(label_names, label_values)
+        yield f"{self.name}_sum{lbl} {_fmt(sum_)}"
+        yield f"{self.name}_count{lbl} {total}"
+
+    def collect(self) -> Iterable[str]:
+        # snapshot under the lock, format outside it: a concurrent
+        # observe() must never see buckets inconsistent with _count/_sum
+        with self._lock:
+            if self.label_names:
+                series = [(k, list(s[0]), s[1], s[2])
+                          for k, s in sorted(self._series.items())]
+            else:
+                counts, sum_, total = list(self._counts), self._sum, self._total
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} histogram"
+        if self.label_names:
+            for key, c, s, t in series:
+                yield from self._emit_series(self.label_names, key, c, s, t)
+        else:
+            yield from self._emit_series((), (), counts, sum_, total)
 
 
 def _fmt(v: float) -> str:
@@ -112,10 +168,17 @@ def _fmt(v: float) -> str:
     return repr(float(v))
 
 
+def _escape_label_value(v) -> str:
+    # exposition format: backslash first, then quote and newline
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _fmt_labels(names, values) -> str:
     if not names:
         return ""
-    inner = ",".join(f'{n}="{v}"' for n, v in zip(names, values))
+    inner = ",".join(f'{n}="{_escape_label_value(v)}"'
+                     for n, v in zip(names, values))
     return "{" + inner + "}"
 
 
@@ -124,6 +187,9 @@ class Registry:
         self._metrics = []
 
     def register(self, m):
+        """Accepts any object with a ``collect() -> Iterable[str]``
+        method — custom collectors (e.g. the router's breaker-state
+        gauges, computed at scrape time) register alongside metrics."""
         self._metrics.append(m)
 
     def expose(self) -> str:
@@ -162,6 +228,25 @@ class EngineMetrics:
         self.e2e_latency = Histogram(
             "kaito:e2e_request_latency_seconds", "End-to-end request latency", r)
         if engine is not None:
+            # the engine owns its step/queue-wait histograms (observed
+            # from the scheduler thread); expose them through this
+            # registry rather than duplicating series
+            for attr in ("step_hist", "queue_wait_hist"):
+                h = getattr(engine, attr, None)
+                if h is not None:
+                    r.register(h)
+
+            def _occupancy():
+                slots = getattr(engine, "slots", None)
+                if slots is not None:
+                    denom = len(slots)
+                else:
+                    denom = engine.cfg.max_num_seqs * max(
+                        1, getattr(engine.cfg, "data_parallel", 1))
+                return engine.num_running / max(1, denom)
+
+            Gauge("kaito:batch_occupancy",
+                  "Active decode slots / max batch size", r, fn=_occupancy)
             Gauge("kaito:num_requests_running", "Active decode slots", r,
                   fn=lambda: engine.num_running)
             Gauge("kaito:num_requests_waiting", "Queued requests", r,

@@ -81,6 +81,7 @@ def _wire_request(req: Request) -> dict:
         "repetition_penalty": p.repetition_penalty,
         "min_p": p.min_p,
         "adapter": req.adapter,
+        "trace_id": req.trace_id,
     }
 
 
@@ -96,7 +97,8 @@ def _unwire_request(item: dict) -> Request:
         repetition_penalty=float(item.get("repetition_penalty", 1.0)),
         min_p=float(item.get("min_p", 0.0)))
     return Request(item["req_id"], list(item["tokens"]), params,
-                   adapter=item.get("adapter", ""))
+                   adapter=item.get("adapter", ""),
+                   trace_id=item.get("trace_id") or item["req_id"])
 
 
 class MultiHostEngine(InferenceEngine):
@@ -120,7 +122,7 @@ class MultiHostEngine(InferenceEngine):
 
     def submit(self, prompt_tokens, params, req_id=None,
                export_kv=False, adapter: str = "",
-               timeout_s=None) -> Request:
+               timeout_s=None, trace_id=None) -> Request:
         if not self.is_leader:
             raise RuntimeError("submit() is leader-only; workers receive "
                                "requests via the step broadcast")
@@ -139,9 +141,11 @@ class MultiHostEngine(InferenceEngine):
 
                 params = dataclasses.replace(
                     params, seed=self.counters["requests_total"])
-            req = Request(req_id or f"req-{self.counters['requests_total']}",
+            rid = req_id or f"req-{self.counters['requests_total']}"
+            req = Request(rid,
                           list(prompt_tokens), params, adapter=adapter,
-                          deadline=self._deadline_for(timeout_s))
+                          deadline=self._deadline_for(timeout_s),
+                          trace_id=trace_id or rid)
             self._staged.append(req)
         self._wake.set()
         return req
@@ -232,6 +236,11 @@ class MultiHostEngine(InferenceEngine):
             req = self._live.get(rid)
             if req is not None:
                 req.aborted = True
+                # the abort crossed the step broadcast: every process
+                # records it under the request's end-to-end trace id
+                self.tracer.record("abort.broadcast",
+                                   req.trace_id or rid,
+                                   time.monotonic(), 0.0, req_id=rid)
 
     def _prune_live(self):
         for rid in [rid for rid, r in self._live.items()

@@ -368,7 +368,8 @@ class StagedExport:
 
 def stage_export(cache: KVCache, pages: list[int], *, n_tokens: int,
                  model: str, prompt_tokens: list[int],
-                 first_token: int, lazy_drain: bool = False) -> StagedExport:
+                 first_token: int, lazy_drain: bool = False,
+                 trace_id: str = "") -> StagedExport:
     """Engine-thread entry: on-device gather + chunk plan; returns the
     staged export whose copier is already draining.
 
@@ -384,6 +385,10 @@ def stage_export(cache: KVCache, pages: list[int], *, n_tokens: int,
             "v_shape": [int(s) for s in v_dev.shape],
             "dtype": str(k_dev.dtype), "n_tokens": n_tokens,
             "model": model, "chunks": [p.to_json() for p in plans]}
+    if trace_id:
+        # trace identity rides the handoff meta so the decode role's
+        # spans land under the SAME X-Request-Id (docs/observability.md)
+        meta["trace_id"] = trace_id
     return StagedExport(k_dev, v_dev, meta, plans, prompt_tokens,
                         first_token, lazy_drain=lazy_drain)
 

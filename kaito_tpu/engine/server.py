@@ -26,6 +26,9 @@ from kaito_tpu.engine.config import EngineConfig
 from kaito_tpu.engine.engine import InferenceEngine, SamplingParams
 from kaito_tpu.engine.metrics import EngineMetrics
 from kaito_tpu.engine.rate_limit import RateLimiter
+from kaito_tpu.utils.tracing import (chrome_trace, make_request_id,
+                                     parse_traceparent, sanitize_request_id,
+                                     timeline_trace)
 
 logger = logging.getLogger(__name__)
 
@@ -75,11 +78,24 @@ class OpenAIHandler(BaseHTTPRequestHandler):
 
     # ---------------- helpers ----------------
 
+    def _intake_trace(self):
+        """Resolve this request's end-to-end trace id: the client's
+        ``X-Request-Id`` wins, then the trace-id of an inbound W3C
+        ``traceparent``, else a fresh id.  Every response echoes it
+        (docs/observability.md trace-header contract)."""
+        hdr = (sanitize_request_id(self.headers.get("X-Request-Id"))
+               or parse_traceparent(self.headers.get("traceparent")))
+        self._rid_client = hdr is not None
+        self._rid = hdr or make_request_id()
+
     def _json(self, code: int, obj: dict, headers: Optional[dict] = None):
         body = json.dumps(obj).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        rid = getattr(self, "_rid", None)
+        if rid:
+            self.send_header("X-Request-Id", rid)
         for k, v in (headers or {}).items():
             self.send_header(k, str(v))
         self.end_headers()
@@ -88,8 +104,11 @@ class OpenAIHandler(BaseHTTPRequestHandler):
     def _error(self, code: int, message: str,
                etype: str = "invalid_request_error",
                headers: Optional[dict] = None):
-        self._json(code, {"error": {"message": message, "type": etype}},
-                   headers=headers)
+        err = {"message": message, "type": etype}
+        rid = getattr(self, "_rid", None)
+        if rid:
+            err["request_id"] = rid
+        self._json(code, {"error": err}, headers=headers)
 
     def _request_error(self, req) -> None:
         """Surface a request's structured engine error (scoped failure
@@ -114,6 +133,9 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         self.send_header("Content-Type", "text/event-stream")
         self.send_header("Cache-Control", "no-cache")
         self.send_header("Transfer-Encoding", "chunked")
+        rid = getattr(self, "_rid", None)
+        if rid:
+            self.send_header("X-Request-Id", rid)
         self.end_headers()
 
     def _sse_send(self, obj) -> None:
@@ -130,6 +152,7 @@ class OpenAIHandler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         st = self.state
+        self._intake_trace()
         if self.path == "/health":
             body = {"status": "ok"}
             sizing = getattr(st.engine, "sizing_report", None)
@@ -167,10 +190,46 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                 models.append({"id": name, "object": "model",
                                "owned_by": "kaito-tpu", "parent": st.model_name})
             self._json(200, {"object": "list", "data": models})
+        elif self.path.startswith("/debug/trace"):
+            self._debug_trace()
+        elif self.path.startswith("/debug/timeline"):
+            self._debug_timeline()
         else:
             self._error(404, f"no route {self.path}")
 
+    def _sub_engines(self) -> list:
+        """Engine groups behind this server: the DP facade exposes its
+        groups via `.engines`; a plain engine is its own only group."""
+        return list(getattr(self.state.engine, "engines",
+                            [self.state.engine]))
+
+    def _debug_trace(self):
+        """Chrome trace-event JSON of recorded spans (Perfetto-loadable),
+        merged across engine groups; `?trace_id=` filters to one
+        request's span tree."""
+        from urllib.parse import parse_qs, urlparse
+
+        q = parse_qs(urlparse(self.path).query)
+        tid = q.get("trace_id", [None])[0]
+        spans = []
+        for e in self._sub_engines():
+            tr = getattr(e, "tracer", None)
+            if tr is not None:
+                spans.extend(tr.spans(tid))
+        self._json(200, chrome_trace(spans))
+
+    def _debug_timeline(self):
+        """Chrome trace-event JSON of the engine-step flight recorder,
+        merged across engine groups."""
+        recs = []
+        for e in self._sub_engines():
+            tl = getattr(e, "timeline", None)
+            if tl is not None:
+                recs.extend(tl.records())
+        self._json(200, timeline_trace(recs))
+
     def do_DELETE(self):
+        self._intake_trace()
         if self.path.startswith("/pd/kv/"):
             # decode side declined the transfer (below break-even):
             # release the staged export instead of waiting out the TTL
@@ -183,6 +242,7 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             self._error(404, f"no route {self.path}")
 
     def do_POST(self):
+        self._intake_trace()
         if self.path == "/v1/completions":
             self._completions(chat=False)
         elif self.path == "/v1/chat/completions":
@@ -291,13 +351,15 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         try:
             req = st.engine.submit(tokens, params,
                                    req_id=f"pd-{uuid.uuid4().hex[:16]}",
-                                   export_kv=True)
+                                   export_kv=True,
+                                   trace_id=self._rid)
         except ValueError as e:
             return self._error(400, str(e))
         toks = list(req.stream())
         if not toks and req.finish_reason in ("error", "deadline"):
             return self._request_error(req)
         self._json(200, {"req_id": req.req_id,
+                         "request_id": self._rid,
                          "first_token": req.output_tokens[0],
                          "n_tokens": len(tokens),
                          "prompt_tokens": tokens})
@@ -384,6 +446,13 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             raise
         reg.drop_served(req_id)
 
+    def _adopt_handoff_trace(self, meta: dict) -> None:
+        """PD decode role: when the client sent no trace header, adopt
+        the trace id the prefill role stamped into the staged meta, so
+        both roles' spans land under ONE id."""
+        if not getattr(self, "_rid_client", False) and meta.get("trace_id"):
+            self._rid = str(meta["trace_id"])
+
     def _submit_with_transfer(self, kv_src: dict, params,
                               timeout_s: float = 0.0):
         """Continue decoding from a remote prefill's KV.
@@ -436,12 +505,14 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                     if slabs is not None:
                         logger.info("kv_transfer %s: colocated source, "
                                     "device-to-device hand-off", req_id)
+                        self._adopt_handoff_trace(staged.meta)
                         try:
                             return eng.submit_with_kv_device(
                                 prompt_tokens, first, staged.meta, slabs,
                                 params,
                                 req_id=f"cmpl-{uuid.uuid4().hex[:20]}",
-                                timeout_s=timeout_s)
+                                timeout_s=timeout_s,
+                                trace_id=self._rid)
                         except ValueError:
                             # a rejected submit must not destroy the
                             # prefill result: re-stage for retry/wire
@@ -481,7 +552,7 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                              name="pd-release").start()
             return eng.submit(prompt_tokens, params,
                               req_id=f"cmpl-{uuid.uuid4().hex[:20]}",
-                              timeout_s=timeout_s)
+                              timeout_s=timeout_s, trace_id=self._rid)
         try:
             with urllib.request.urlopen(f"{url}/pd/kv/{req_id}/meta",
                                         timeout=30) as r:
@@ -491,11 +562,12 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         except Exception as e:
             self._error(502, f"KV meta pull from {url} failed: {e}")
             return None
+        self._adopt_handoff_trace(meta)
         try:
             req = eng.submit_with_kv_chunked(
                 prompt_tokens, first, meta, plans, params,
                 req_id=f"cmpl-{uuid.uuid4().hex[:20]}",
-                timeout_s=timeout_s)
+                timeout_s=timeout_s, trace_id=self._rid)
         except ValueError as e:
             self._error(400, str(e))
             return None
@@ -543,6 +615,12 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         if shed is not None:
             st.metrics.requests_rejected.inc()
             st.metrics.requests_shed.inc(reason=shed)
+            try:
+                # best-effort: the flight recorder reports shed pressure
+                # per step (the DP facade's computed counters drop this)
+                st.engine.counters["requests_shed_total"] += 1
+            except (KeyError, TypeError):
+                pass
             retry_after = st.limiter.retry_after_s(st.engine)
             self._error(429,
                         "engine queue full, retry later" if shed == "queue_full"
@@ -707,7 +785,8 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             else:
                 req = st.engine.submit(tokens, params,
                                        req_id=f"cmpl-{uuid.uuid4().hex[:20]}",
-                                       adapter=adapter, timeout_s=timeout_s)
+                                       adapter=adapter, timeout_s=timeout_s,
+                                       trace_id=self._rid)
         except ValueError as e:
             return self._error(400, str(e))
 
@@ -722,7 +801,8 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             try:
                 extra_reqs.append(st.engine.submit(
                     tokens, p_i, req_id=f"{req.req_id}-{ci}",
-                    adapter=adapter, timeout_s=timeout_s))
+                    adapter=adapter, timeout_s=timeout_s,
+                    trace_id=self._rid))
             except ValueError as e:
                 for r in [req] + extra_reqs:
                     st.engine.abort(r)
@@ -1088,6 +1168,10 @@ def main(argv=None):
     ap.add_argument("--kv-import-retries", type=int, default=1,
                     help="transient KV-transfer failures fall back to "
                          "local recompute this many times per request")
+    ap.add_argument("--slow-request-threshold-s", type=float, default=0.0,
+                    help="dump a request's span tree to the log when its "
+                         "end-to-end latency crosses this (0 = off); see "
+                         "docs/observability.md")
     args = ap.parse_args(argv)
 
     import jax
@@ -1126,6 +1210,7 @@ def main(argv=None):
         request_timeout_s=args.request_timeout_s,
         kv_shed_threshold=args.kv_shed_threshold,
         kv_import_retries=args.kv_import_retries,
+        slow_request_threshold_s=args.slow_request_threshold_s,
     )
     if args.kaito_config_file:
         cfg = load_config_file(cfg, args.kaito_config_file)

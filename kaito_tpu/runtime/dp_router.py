@@ -41,7 +41,10 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from kaito_tpu.engine.metrics import Counter, Histogram, Registry
 from kaito_tpu.utils.failpoints import FAILPOINTS, FailpointError
+from kaito_tpu.utils.tracing import (make_request_id, parse_traceparent,
+                                     sanitize_request_id)
 
 logger = logging.getLogger(__name__)
 
@@ -113,6 +116,24 @@ class _Backend:
         self.down_until = 0.0
 
 
+class _BreakerStateCollector:
+    """Scrape-time breaker gauge: state is time-derived (``down_until``
+    vs now), so it must be computed at collect(), not stored."""
+
+    _STATES = {"closed": 0, "half-open": 1, "open": 2}
+
+    def __init__(self, router: "DPRouter"):
+        self.router = router
+
+    def collect(self):
+        yield ("# HELP kaito:router_backend_breaker_state Circuit "
+               "breaker per backend (0=closed, 1=half-open, 2=open)")
+        yield "# TYPE kaito:router_backend_breaker_state gauge"
+        for b in self.router.backends:
+            yield (f'kaito:router_backend_breaker_state'
+                   f'{{backend="{b.url}"}} {self._STATES[b.state]}')
+
+
 class DPRouter:
     """Round-robin chooser over backends, shared by handler threads."""
 
@@ -124,6 +145,29 @@ class DPRouter:
         self._lock = threading.Lock()
         self.draining = False
         self._inflight = 0
+        # router's OWN /metrics (docs/observability.md): the engine
+        # replicas each expose theirs; these series cover the relay tier
+        r = Registry()
+        self.registry = r
+        self.m_forwarded = Counter(
+            "kaito:router_requests_forwarded_total",
+            "Requests relayed to a backend (response head received)",
+            r, labels=("backend",))
+        self.m_retries = Counter(
+            "kaito:router_retries_total",
+            "Relay attempts beyond each request's first", r,
+            labels=("backend",))
+        self.m_failures = Counter(
+            "kaito:router_backend_failures_total",
+            "Connect/forward failures that skipped a backend", r,
+            labels=("backend",))
+        self.upstream_latency = Histogram(
+            "kaito:router_upstream_latency_seconds",
+            "Forward-to-response-head latency per backend", r,
+            buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                     0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0),
+            labels=("backend",))
+        r.register(_BreakerStateCollector(self))
 
     def next_backend(self) -> Optional[_Backend]:
         """Next live backend (round robin), or the next one regardless
@@ -240,6 +284,9 @@ def make_router_server(router: DPRouter, host: str = "0.0.0.0",
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            rid = getattr(self, "_rid", None)
+            if rid:
+                self.send_header("X-Request-Id", rid)
             for k, v in (headers or {}).items():
                 self.send_header(k, str(v))
             self.end_headers()
@@ -268,8 +315,25 @@ def make_router_server(router: DPRouter, host: str = "0.0.0.0",
             return self.rfile.read(length) if length else None
 
         def _relay(self, method: str):
+            # end-to-end tracing: accept the caller's X-Request-Id (or
+            # a W3C traceparent), mint one otherwise, and forward it so
+            # router + engine logs/spans correlate on one id.
+            self._rid = (sanitize_request_id(self.headers.get("X-Request-Id"))
+                         or parse_traceparent(self.headers.get("traceparent"))
+                         or make_request_id())
             if self.path == "/router/stats":
                 self._send_json(200, router.stats())
+                return
+            if self.path == "/metrics" and method == "GET":
+                # the router's OWN series, never forwarded: per-backend
+                # forwards/retries/failures, breaker state, latency
+                body = router.registry.expose().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
                 return
             if not router.begin_request():
                 self._send_json(503, {"error": "router draining"},
@@ -296,6 +360,7 @@ def make_router_server(router: DPRouter, host: str = "0.0.0.0",
             retryable = _retryable(method, self.path)
             cycles = RETRY_CYCLES if retryable else 1
             last_status: Optional[int] = None
+            attempts = 0
             for cycle in range(cycles):
                 if cycle:
                     time.sleep(RETRY_BACKOFF_S * (1 + random.random()))
@@ -303,13 +368,20 @@ def make_router_server(router: DPRouter, host: str = "0.0.0.0",
                 while tried < len(router.backends):
                     b = router.next_backend()
                     tried += 1
+                    attempts += 1
+                    if attempts > 1:
+                        router.m_retries.inc(backend=b.url)
+                    t_fwd = time.monotonic()
                     try:
                         resp, conn = self._connect(b, method, body)
                     except (ConnectionError, OSError, FailpointError) as e:
                         logger.warning("backend %s unreachable (%s); "
                                        "skipping", b.url, e)
+                        router.m_failures.inc(backend=b.url)
                         b.mark_down()
                         continue
+                    router.upstream_latency.observe(
+                        time.monotonic() - t_fwd, backend=b.url)
                     if retryable and resp.status in (502, 503) \
                             and (cycle + 1 < cycles
                                  or tried < len(router.backends)):
@@ -320,6 +392,7 @@ def make_router_server(router: DPRouter, host: str = "0.0.0.0",
                         conn.close()
                         continue
                     b.mark_up()
+                    router.m_forwarded.inc(backend=b.url)
                     self._stream_response(b, method, resp, conn)
                     return
             self._send_json(503 if last_status is None else last_status,
@@ -334,7 +407,9 @@ def make_router_server(router: DPRouter, host: str = "0.0.0.0",
             conn = http.client.HTTPConnection(b.host, b.port, timeout=600)
             headers = {k: v for k, v in self.headers.items()
                        if k.lower() not in HOP_HEADERS
-                       and k.lower() != "content-length"}
+                       and k.lower() not in ("content-length",
+                                             "x-request-id")}
+            headers["X-Request-Id"] = self._rid
             conn.request(method, self.path, body=body, headers=headers)
             return conn.getresponse(), conn
 

@@ -37,15 +37,23 @@ class Handler(BaseHTTPRequestHandler):
         elif self.path == "/metrics":
             m = self._read()
             done = os.path.exists(os.path.join(self.results_dir, SENTINEL))
+            # series live in the same kaito: namespace as the engine's
+            # (docs/observability.md) so one scrape config covers both
             lines = [
-                "# TYPE kaito_tuning_step gauge",
-                f"kaito_tuning_step {m.get('step', 0)}",
-                "# TYPE kaito_tuning_loss gauge",
-                f"kaito_tuning_loss {m.get('loss', 0.0)}",
-                "# TYPE kaito_tuning_tokens_per_second gauge",
-                f"kaito_tuning_tokens_per_second {m.get('tokens_per_second', 0.0)}",
-                "# TYPE kaito_tuning_completed gauge",
-                f"kaito_tuning_completed {1 if done else 0}",
+                "# HELP kaito:tuning_step Last trainer optimizer step",
+                "# TYPE kaito:tuning_step gauge",
+                f"kaito:tuning_step {m.get('step', 0)}",
+                "# HELP kaito:tuning_loss Last reported training loss",
+                "# TYPE kaito:tuning_loss gauge",
+                f"kaito:tuning_loss {m.get('loss', 0.0)}",
+                "# HELP kaito:tuning_tokens_per_second Trainer throughput",
+                "# TYPE kaito:tuning_tokens_per_second gauge",
+                f"kaito:tuning_tokens_per_second "
+                f"{m.get('tokens_per_second', 0.0)}",
+                "# HELP kaito:tuning_completed 1 once the job sentinel "
+                "file exists",
+                "# TYPE kaito:tuning_completed gauge",
+                f"kaito:tuning_completed {1 if done else 0}",
             ]
             body = ("\n".join(lines) + "\n").encode()
             ctype = "text/plain; version=0.0.4"

@@ -1,0 +1,445 @@
+"""End-to-end request tracing: trace-id plumbing, the span ring and
+step flight recorder, the router's own metrics, and the /debug export
+surface (docs/observability.md).
+
+Fast tier: pure tracing-unit tests plus router tests against cheap
+in-process stub backends (no engine, no XLA).  The ``@pytest.mark.slow``
+tests boot real engines and prove the acceptance path: a request
+through dp_router -> engine comes back with an ``X-Request-Id`` whose
+span tree covers queue -> admission -> prefill -> decode, PD handoff
+spans share one id across both roles, and /debug/timeline is valid
+Chrome trace JSON.
+"""
+
+import json
+import logging
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from kaito_tpu.utils.tracing import (RingTracer, Span, StepTimeline,
+                                     chrome_trace, format_span_tree,
+                                     make_request_id, parse_traceparent,
+                                     sanitize_request_id, timeline_trace)
+
+# ---------------------------------------------------------------------------
+# tracing units (fast)
+# ---------------------------------------------------------------------------
+
+
+def test_parse_traceparent():
+    tid = "a" * 32
+    assert parse_traceparent(f"00-{tid}-{'b' * 16}-01") == tid
+    # case-insensitive per spec; normalized to lowercase
+    assert parse_traceparent(f"00-{'A' * 32}-{'B' * 16}-01") == "a" * 32
+    for bad in (None, "", "garbage", f"00-{'0' * 32}-{'b' * 16}-01",
+                f"00-{tid}-{'b' * 15}-01", f"00-{tid[:-1]}-{'b' * 16}-01",
+                f"zz{tid}"):
+        assert parse_traceparent(bad) is None
+
+
+def test_sanitize_request_id():
+    assert sanitize_request_id("req-1.2:a_B") == "req-1.2:a_B"
+    assert sanitize_request_id("  spaced id\n") == "spacedid"
+    assert sanitize_request_id("x" * 500) == "x" * 128
+    assert sanitize_request_id("\n\t ") is None
+    assert sanitize_request_id(None) is None
+    assert sanitize_request_id("") is None
+
+
+def test_make_request_id_is_sanitary_and_unique():
+    a, b = make_request_id(), make_request_id()
+    assert a != b
+    assert sanitize_request_id(a) == a
+
+
+def test_ring_tracer_capacity_and_filter():
+    tr = RingTracer(capacity=3)
+    for i in range(5):
+        tr.record(f"s{i}", "t1" if i % 2 else "t2", float(i), 0.1)
+    assert len(tr) == 3                        # oldest two fell off
+    assert [s.name for s in tr.spans()] == ["s2", "s3", "s4"]
+    assert [s.name for s in tr.spans("t1")] == ["s3"]
+    tr.clear()
+    assert len(tr) == 0
+
+
+def test_ring_tracer_span_context_records_errors():
+    tr = RingTracer()
+    with tr.span("ok", "t", k=1):
+        pass
+    with pytest.raises(ValueError):
+        with tr.span("boom", "t"):
+            raise ValueError("x")
+    ok, boom = tr.spans("t")
+    assert ok.name == "ok" and ok.attrs["k"] == 1 and ok.dur >= 0
+    assert boom.attrs["error"] == "ValueError"
+
+
+def test_chrome_trace_export_shape():
+    tr = RingTracer()
+    tr.record("a", "t1", 1.0, 0.5, slot=3)
+    tr.record("b", "t2", 1.2, 0.1)
+    doc = tr.chrome_trace()
+    json.loads(json.dumps(doc))               # JSON-serializable
+    evs = doc["traceEvents"]
+    names = {e["args"]["name"] for e in evs if e["ph"] == "M"}
+    assert names == {"t1", "t2"}              # one named track per trace
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert {e["name"] for e in xs} == {"a", "b"}
+    a = next(e for e in xs if e["name"] == "a")
+    assert a["ts"] == 1_000_000 and a["dur"] == 500_000   # us
+    assert a["args"]["slot"] == 3 and a["args"]["trace_id"] == "t1"
+    # filtered export keeps only the requested trace
+    only = tr.chrome_trace("t1")["traceEvents"]
+    assert all(e["ph"] == "M" or e["args"]["trace_id"] == "t1"
+               for e in only)
+    assert chrome_trace([]) == {"traceEvents": [],
+                                "displayTimeUnit": "ms"}
+
+
+def test_format_span_tree_nests_by_containment():
+    spans = [Span("request", "t", 0.0, 1.0),
+             Span("queue.wait", "t", 0.0, 0.2),
+             Span("prefill.chunk", "t", 0.2, 0.3),
+             Span("decode", "t", 0.5, 0.5)]
+    out = format_span_tree(spans)
+    lines = out.splitlines()
+    assert lines[0].startswith("request")
+    for inner in lines[1:]:
+        assert inner.startswith("  ")         # children indent under it
+    assert format_span_tree([]) == "(no spans)"
+
+
+def test_step_timeline_and_trace():
+    tl = StepTimeline(capacity=2)
+    tl.add(1.0, 0.01, running=2, waiting=1, kv_pages_used=7)
+    tl.add(1.1, 0.02, running=3, waiting=0, kv_pages_used=9)
+    tl.add(1.2, 0.03, running=1, waiting=0, kv_pages_used=4)
+    assert len(tl) == 2                       # bounded
+    doc = tl.chrome_trace()
+    json.loads(json.dumps(doc))
+    evs = doc["traceEvents"]
+    steps = [e for e in evs if e["ph"] == "X"]
+    assert len(steps) == 2
+    assert steps[0]["args"]["running"] == 3
+    counters = [e for e in evs if e["ph"] == "C"]
+    assert {e["name"] for e in counters} == {"batch", "kv_pages_used"}
+    assert timeline_trace([])["traceEvents"][0]["ph"] == "M"
+
+
+# ---------------------------------------------------------------------------
+# router observability against stub backends (fast; no engine)
+# ---------------------------------------------------------------------------
+
+
+def _stub_backend():
+    """Minimal backend: 200s everything, echoes the X-Request-Id it was
+    forwarded (header + body) and records what it saw."""
+    seen = []
+
+    class H(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def _reply(self):
+            rid = self.headers.get("X-Request-Id", "")
+            seen.append({"path": self.path, "rid": rid})
+            body = json.dumps({"rid": rid}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            if rid:
+                self.send_header("X-Request-Id", rid)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            self._reply()
+
+        def do_POST(self):
+            self.rfile.read(int(self.headers.get("Content-Length") or 0))
+            self._reply()
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, f"http://127.0.0.1:{srv.server_address[1]}", seen
+
+
+@pytest.fixture()
+def routed_stub():
+    from kaito_tpu.runtime.dp_router import DPRouter, make_router_server
+
+    srv, url, seen = _stub_backend()
+    router = DPRouter([url])
+    rsrv = make_router_server(router, host="127.0.0.1", port=0)
+    threading.Thread(target=rsrv.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{rsrv.server_address[1]}", router, seen
+    rsrv.shutdown()
+    srv.shutdown()
+
+
+def test_router_generates_and_forwards_request_id(routed_stub):
+    router_url, router, seen = routed_stub
+    with urllib.request.urlopen(router_url + "/health", timeout=10) as r:
+        rid = r.headers.get("X-Request-Id")
+    assert rid and sanitize_request_id(rid) == rid
+    assert seen[-1]["rid"] == rid             # backend saw the same id
+
+
+def test_router_preserves_client_request_id(routed_stub):
+    router_url, router, seen = routed_stub
+    req = urllib.request.Request(router_url + "/health",
+                                 headers={"X-Request-Id": "client-id-7"})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        assert r.headers.get("X-Request-Id") == "client-id-7"
+    assert seen[-1]["rid"] == "client-id-7"
+
+
+def test_router_accepts_traceparent(routed_stub):
+    router_url, router, seen = routed_stub
+    tid = "ab" * 16
+    req = urllib.request.Request(
+        router_url + "/health",
+        headers={"traceparent": f"00-{tid}-{'cd' * 8}-01"})
+    with urllib.request.urlopen(req, timeout=10):
+        pass
+    assert seen[-1]["rid"] == tid
+
+
+def test_router_metrics_endpoint(routed_stub):
+    router_url, router, seen = routed_stub
+    for _ in range(3):
+        urllib.request.urlopen(router_url + "/v1/models", timeout=10).read()
+    with urllib.request.urlopen(router_url + "/metrics", timeout=10) as r:
+        assert r.headers["Content-Type"].startswith("text/plain")
+        body = r.read().decode()
+    (backend_url,) = [b.url for b in router.backends]
+    assert (f'kaito:router_requests_forwarded_total'
+            f'{{backend="{backend_url}"}}') in body
+    assert (f'kaito:router_backend_breaker_state'
+            f'{{backend="{backend_url}"}} 0') in body
+    assert (f'kaito:router_upstream_latency_seconds_bucket'
+            f'{{backend="{backend_url}",le="+Inf"}}') in body
+    # /metrics and /router/stats are answered locally, never relayed
+    assert all(s["path"] not in ("/metrics", "/router/stats")
+               for s in seen)
+
+
+def test_router_counts_failures_and_retries():
+    from kaito_tpu.runtime.dp_router import DPRouter, make_router_server
+
+    srv, live_url, seen = _stub_backend()
+    dead_url = "http://127.0.0.1:9"            # discard port: refuses
+    router = DPRouter([dead_url, live_url])
+    rsrv = make_router_server(router, host="127.0.0.1", port=0)
+    threading.Thread(target=rsrv.serve_forever, daemon=True).start()
+    try:
+        router_url = f"http://127.0.0.1:{rsrv.server_address[1]}"
+        out = json.loads(urllib.request.urlopen(
+            router_url + "/health", timeout=10).read())
+        assert out["rid"]                      # relayed via the live one
+        body = router.registry.expose()
+        assert router.m_failures.value(backend=dead_url) >= 1
+        assert router.m_forwarded.value(backend=live_url) >= 1
+        assert router.m_retries.value(backend=live_url) >= 1
+        # one connect failure opens the cooldown => breaker reads open
+        assert (f'kaito:router_backend_breaker_state'
+                f'{{backend="{dead_url}"}} 2') in body
+    finally:
+        rsrv.shutdown()
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# e2e against real engines (slow tier)
+# ---------------------------------------------------------------------------
+
+E2E_CFG = dict(model="tiny-llama-test", max_model_len=256, page_size=16,
+               max_num_seqs=2, dtype="float32", kv_dtype="float32",
+               prefill_buckets=(32, 64, 128), seed=0,
+               # every request trips the slow-request span dump, so the
+               # caplog test below needs no extra engine boot
+               slow_request_threshold_s=1e-4)
+
+
+def _boot_engine(**overrides):
+    from kaito_tpu.engine.config import EngineConfig
+    from kaito_tpu.engine.engine import InferenceEngine
+    from kaito_tpu.engine.server import make_server
+
+    cfg = EngineConfig(**{**E2E_CFG, **overrides})
+    engine = InferenceEngine(cfg)
+    engine.start()
+    server = make_server(engine, cfg, host="127.0.0.1", port=0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return engine, server, f"http://127.0.0.1:{server.server_address[1]}"
+
+
+def _post(url, path, body, headers=None):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    return urllib.request.urlopen(req, timeout=120)
+
+
+def _get_json(url, path):
+    with urllib.request.urlopen(url + path, timeout=30) as r:
+        return json.loads(r.read())
+
+
+@pytest.fixture(scope="module")
+def traced_stack():
+    """One engine server behind the DP router (the sim-mode data
+    plane): (router_url, engine_url, engine, router)."""
+    from kaito_tpu.runtime.dp_router import DPRouter, make_router_server
+
+    engine, srv, engine_url = _boot_engine()
+    router = DPRouter([engine_url])
+    rsrv = make_router_server(router, host="127.0.0.1", port=0)
+    threading.Thread(target=rsrv.serve_forever, daemon=True).start()
+    yield (f"http://127.0.0.1:{rsrv.server_address[1]}", engine_url,
+           engine, router)
+    rsrv.shutdown()
+    srv.shutdown()
+    engine.stop()
+
+
+@pytest.mark.slow
+def test_request_id_spans_router_to_engine(traced_stack):
+    """Acceptance: a completion through dp_router -> engine returns an
+    X-Request-Id whose /debug/trace span tree covers queue ->
+    admission -> prefill -> decode."""
+    router_url, engine_url, engine, _ = traced_stack
+    with _post(router_url, "/v1/completions",
+               {"prompt": "trace me end to end", "max_tokens": 4,
+                "temperature": 0.0}) as r:
+        rid = r.headers.get("X-Request-Id")
+        out = json.loads(r.read())
+    assert rid, "router->engine response must carry X-Request-Id"
+    assert out["usage"]["completion_tokens"] >= 1
+    doc = _get_json(engine_url, f"/debug/trace?trace_id={rid}")
+    names = {e["name"] for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert {"queue.wait", "admit", "prefill.chunk",
+            "decode", "request"} <= names, names
+    # every event in the filtered export belongs to this request
+    assert all(e["args"]["trace_id"] == rid
+               for e in doc["traceEvents"] if e["ph"] == "X")
+
+
+@pytest.mark.slow
+def test_client_request_id_echoed_in_errors(traced_stack):
+    router_url, _, _, _ = traced_stack
+    import urllib.error
+
+    req = urllib.request.Request(
+        router_url + "/v1/completions", data=b"{not json",
+        headers={"Content-Type": "application/json",
+                 "X-Request-Id": "err-trace-1"})
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=30)
+    assert ei.value.headers.get("X-Request-Id") == "err-trace-1"
+    err = json.loads(ei.value.read())
+    assert err["error"]["request_id"] == "err-trace-1"
+
+
+@pytest.mark.slow
+def test_debug_timeline_is_valid_chrome_trace(traced_stack):
+    router_url, engine_url, engine, _ = traced_stack
+    _post(router_url, "/v1/completions",
+          {"prompt": "fill the flight recorder", "max_tokens": 3,
+           "temperature": 0.0}).read()
+    doc = _get_json(engine_url, "/debug/timeline")
+    steps = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert steps, "engine must have recorded non-idle steps"
+    for e in steps:
+        assert e["name"] == "engine.step"
+        assert e["dur"] >= 0 and {"running", "waiting"} <= set(e["args"])
+    assert any(e["ph"] == "C" and e["name"] == "kv_pages_used"
+               for e in doc["traceEvents"])
+    # the recorder counted real work: some step decoded tokens
+    assert any(e["args"].get("decode_tokens", 0) > 0 for e in steps)
+
+
+@pytest.mark.slow
+def test_engine_metrics_gain_step_and_queue_series(traced_stack):
+    router_url, engine_url, engine, _ = traced_stack
+    _post(router_url, "/v1/completions",
+          {"prompt": "observe me", "max_tokens": 2,
+           "temperature": 0.0}).read()
+    with urllib.request.urlopen(engine_url + "/metrics", timeout=30) as r:
+        body = r.read().decode()
+    assert 'kaito:engine_step_seconds_bucket{le="+Inf"}' in body
+    assert 'kaito:queue_wait_seconds_bucket{le="+Inf"}' in body
+    assert "kaito:batch_occupancy" in body
+    assert engine.step_hist.percentile(0.5) > 0.0
+
+
+@pytest.mark.slow
+def test_slow_request_logs_span_tree(traced_stack, caplog):
+    router_url, _, engine, _ = traced_stack
+    with caplog.at_level(logging.WARNING, logger="kaito_tpu.engine.engine"):
+        with _post(router_url, "/v1/completions",
+                   {"prompt": "log my span tree", "max_tokens": 2,
+                    "temperature": 0.0}) as r:
+            rid = r.headers.get("X-Request-Id")
+            r.read()
+        # the warning fires on the engine thread just before the
+        # response completes; allow a beat for the record to land
+        for _ in range(50):
+            if any("slow request" in m for m in caplog.messages):
+                break
+            time.sleep(0.02)
+    slow = [m for m in caplog.messages if "slow request" in m
+            and rid in m]
+    assert slow, caplog.messages
+    assert "request" in slow[-1] and "decode" in slow[-1]
+
+
+@pytest.mark.slow
+def test_pd_handoff_shares_trace_id():
+    """Acceptance: prefill and decode roles record spans under ONE
+    trace id — carried by the staged-export meta — and the decode
+    response echoes it even though the decode client sent no header."""
+    pre_eng, pre_srv, pre_url = _boot_engine(pd_enabled=True,
+                                             prefill_buckets=(64, 128))
+    dec_eng, dec_srv, dec_url = _boot_engine(pd_enabled=True,
+                                             prefill_buckets=(64, 128))
+    try:
+        tid = "pd-shared-trace-1"
+        prompt = "hello disaggregated tracing"
+        with _post(pre_url, "/pd/prefill",
+                   {"prompt": prompt, "temperature": 0.0},
+                   headers={"X-Request-Id": tid}) as r:
+            assert r.headers.get("X-Request-Id") == tid
+            pre = json.loads(r.read())
+        assert pre["request_id"] == tid
+        # decode pod: NO client header — the id must ride the handoff
+        with _post(dec_url, "/v1/completions",
+                   {"prompt": prompt, "max_tokens": 4, "temperature": 0.0,
+                    "kv_transfer": {"source_url": pre_url,
+                                    "req_id": pre["req_id"],
+                                    "prompt_tokens": pre["prompt_tokens"],
+                                    "first_token": pre["first_token"],
+                                    "force": True, "wire": "http"}}) as r:
+            assert r.headers.get("X-Request-Id") == tid
+            out = json.loads(r.read())
+        assert out["usage"]["completion_tokens"] >= 1
+        for url, role in ((pre_url, "prefill"), (dec_url, "decode")):
+            doc = _get_json(url, f"/debug/trace?trace_id={tid}")
+            xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+            assert xs, f"{role} role recorded no spans under {tid}"
+        dec_names = {e["name"] for e in _get_json(
+            dec_url, f"/debug/trace?trace_id={tid}")["traceEvents"]
+            if e["ph"] == "X"}
+        assert "kv.import.chunked" in dec_names, dec_names
+    finally:
+        for s in (pre_srv, dec_srv):
+            s.shutdown()
+        pre_eng.stop()
+        dec_eng.stop()
