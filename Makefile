@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: all native unit-test unit-test-fast unit-test-slow engine-test rag-test chaos obs bench serve manager clean
+.PHONY: all native unit-test unit-test-fast unit-test-slow engine-test rag-test chaos kvq obs bench serve manager clean
 
 all: native
 
@@ -33,6 +33,13 @@ rag-test:
 # engine containment tests
 chaos:
 	$(PYTHON) -m pytest tests/test_failpoints.py -q
+
+# int8 KV-cache suite (docs/kv-cache.md): quantization round trips,
+# kernel dequant parity, P/D scale wire format, golden-pinned int8
+# serving on the committed real checkpoints
+kvq:
+	$(PYTHON) -m pytest tests/test_kv_quant.py -q
+	$(PYTHON) -m pytest tests/test_real_checkpoint.py -q -k "kv_int8"
 
 # observability suite (docs/observability.md): tracing, flight
 # recorder, router metrics, exposition-format invariants — fast tier

@@ -213,6 +213,8 @@ def orchestrate(args):
         passthru += ["--attn-impl", args.attn_impl]
     if args.quant:
         passthru += ["--quant", args.quant]
+    if args.kv_dtype:
+        passthru += ["--kv-dtype", args.kv_dtype]
     passthru += ["--prompt-len", str(args.prompt_len),
                  "--decode-steps", str(args.decode_steps),
                  "--repeats", str(args.repeats)]
@@ -224,6 +226,26 @@ def orchestrate(args):
             merged.update(res)
         else:
             merged.setdefault("errors", []).append(res.get("error", "raw failed"))
+        save_partial()
+
+    # --- phase: raw ladder again with an int8 KV pool (the bf16-vs-int8
+    # decode row; same batch/shape knobs, only the page pool changes) ---
+    if (not args.skip_kv_int8 and args.kv_dtype != "int8"
+            and remaining() > 60):
+        res = run_phase("raw", passthru + ["--kv-dtype", "int8"],
+                        min(remaining(), 700.0))
+        if "value" in res and res.get("value", 0) > 0:
+            merged["kv_int8_decode_tok_s"] = res["value"]
+            merged["kv_int8_metric"] = res.get("metric", "")
+            for k in ("mfu_pct", "hbm_roofline_pct", "batch", "ttft_p50_ms"):
+                if k in res:
+                    merged[f"kv_int8_{k}"] = res[k]
+            if merged.get("value", 0) > 0:
+                merged["kv_int8_speedup"] = round(
+                    res["value"] / merged["value"], 3)
+        else:
+            merged.setdefault("errors", []).append(
+                res.get("error", "kv-int8 raw failed"))
         save_partial()
 
     # --- phase: serving path (engine under load) ---
@@ -505,6 +527,42 @@ def _bench_serving_once(model_name: str, on_tpu: bool, quant: str,
     return out
 
 
+def _roofline_metrics(arch, tok_s, batch, ctx, *, quant="", kv_dtype="",
+                      page_size=64, chip_name="v5e"):
+    """MFU and HBM-roofline utilization for a decode-throughput number
+    vs the chip peaks in sku/catalog.py (v5e unless overridden).
+
+    Decode does ~2 FLOPs per parameter per token and re-reads the full
+    weight set plus every live sequence's KV each step, so:
+
+      mfu_pct          = 100 * tok_s * 2 * params / peak_flops
+      hbm_roofline_pct = 100 * tok_s * bytes_per_token / peak_bw
+      bytes_per_token  = (param_bytes + batch * ctx * kv_bpt) / batch
+
+    An int8 KV pool halves kv_bpt (plus the fp32 page-scale rows), so
+    the same tok/s scores LOWER here — headroom the quantized cache
+    opened up.  On CPU the percentages are notional (still emitted so
+    rows stay schema-stable)."""
+    from kaito_tpu.sku.catalog import CHIP_CATALOG
+
+    chip = CHIP_CATALOG[chip_name]
+    n_params = arch.param_count()
+    peak_flops = (chip.int8_tops if quant == "int8"
+                  else chip.bf16_tflops) * 1e12
+    param_bytes = n_params * (1 if quant == "int8" else 2)
+    kv_elt = 1 if kv_dtype == "int8" else 2
+    kv_bpt = (2.0 * arch.num_layers * arch.num_kv_heads
+              * arch.head_dim * kv_elt)
+    if kv_dtype == "int8":
+        kv_bpt += 8.0 * arch.num_layers * arch.num_kv_heads / page_size
+    bytes_per_tok = (param_bytes + batch * ctx * kv_bpt) / max(1, batch)
+    return {
+        "mfu_pct": round(100.0 * tok_s * 2.0 * n_params / peak_flops, 2),
+        "hbm_roofline_pct": round(
+            100.0 * tok_s * bytes_per_tok / (chip.hbm_gbps * 1e9), 2),
+    }
+
+
 def phase_raw(args):
     """Raw ladder: prefill + fused decode loop at the widest batch that
     fits, plus steady-state batch-1 TTFT."""
@@ -535,6 +593,9 @@ def phase_raw(args):
     else:
         batch_ladder = [112, 96, 64]
     dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    # KV pool dtype rides independently of compute dtype: int8 pages +
+    # fp32 page scales (engine/kv_cache.py) halve the per-step KV read
+    kv_dt = jnp.int8 if args.kv_dtype == "int8" else dtype
     md = get_model_by_name(model_name)
     arch = md.arch
 
@@ -577,7 +638,7 @@ def phase_raw(args):
             tables[b] = np.arange(1 + b * pages_per_seq,
                                   1 + (b + 1) * pages_per_seq)
         page_tables = jnp.asarray(tables)
-        cache = create_kv_cache(arch, num_pages, page_size, dtype)
+        cache = create_kv_cache(arch, num_pages, page_size, kv_dt)
         log(f"[{impl}] batch {batch}: {num_pages} pages "
             f"({2 * cache.k.nbytes / 2**30:.2f} GiB kv)")
         prefill = jax.jit(model.prefill, donate_argnums=(1,))
@@ -634,13 +695,13 @@ def phase_raw(args):
         tl1 = jnp.full((1,), args.prompt_len, jnp.int32)
         pt1 = jnp.arange(1, 1 + pages_per_seq, dtype=jnp.int32)[None]
         prefill1 = jax.jit(model.prefill, donate_argnums=(1,))
-        cache1 = create_kv_cache(arch, pages_per_seq + 1, page_size, dtype)
+        cache1 = create_kv_cache(arch, pages_per_seq + 1, page_size, kv_dt)
         cache1, lg1, _ = prefill1(params, cache1, t1, tl1, pt1)  # compile
         jax.block_until_ready(lg1)
         ttfts = []
         for _ in range(max(args.repeats, 3)):
             cache1 = create_kv_cache(arch, pages_per_seq + 1, page_size,
-                                     dtype)
+                                     kv_dt)
             t0 = time.monotonic()
             cache1, lg1, _ = prefill1(params, cache1, t1, tl1, pt1)
             jax.block_until_ready(lg1)
@@ -696,6 +757,8 @@ def phase_raw(args):
         ttft_ms = None
 
     suffix = "_int8" if args.quant == "int8" else ""
+    if args.kv_dtype == "int8":
+        suffix += "_kvint8"
     result = {
         "metric": f"{model_name}{suffix}_decode_throughput",
         "value": round(best, 1),
@@ -704,7 +767,12 @@ def phase_raw(args):
         "batch": batch,
         "platform": platform,
         "attn_impl": attn_impl,
+        "kv_dtype": ("int8" if args.kv_dtype == "int8"
+                     else ("bfloat16" if on_tpu else "float32")),
     }
+    result.update(_roofline_metrics(
+        arch, best, batch, total_len, quant=args.quant,
+        kv_dtype=args.kv_dtype, page_size=page_size))
     if ttft_ms is not None:
         result["ttft_p50_ms"] = round(ttft_ms, 1)
     print(json.dumps(result), flush=True)
@@ -855,6 +923,12 @@ def main():
     ap.add_argument("--repeats", type=int, default=3)
     ap.add_argument("--attn-impl", default="", choices=["", "jax", "pallas"])
     ap.add_argument("--quant", default="", choices=["", "int8"])
+    ap.add_argument("--kv-dtype", default="",
+                    choices=["", "bfloat16", "int8"],
+                    help="KV page-pool dtype for the raw decode ladder "
+                         "(int8 = quantized pages + fp32 page scales)")
+    ap.add_argument("--skip-kv-int8", action="store_true",
+                    help="skip the int8-KV decode comparison row")
     ap.add_argument("--force-cpu", action="store_true")
     ap.add_argument("--skip-server-bench", action="store_true")
     ap.add_argument("--skip-int8-8b", action="store_true")

@@ -62,6 +62,79 @@ def bench_decode(iters: int) -> None:
               f"effective live-KV bw {live_bytes / dt / 1e9:6.1f} GB/s")
 
 
+def bench_decode_int8(iters: int) -> None:
+    """bf16-vs-int8 KV decode row.
+
+    The int8 row runs the SAME pallas kernel against quantized pages +
+    per-page-per-head fp32 scales (the layout engine/kv_cache.py
+    writes): the page DMA moves half the bytes, which is the decode
+    bottleneck.  On CPU the kernel runs in interpreter mode at tiny
+    shapes so the row stays runnable anywhere — parity is the point
+    there; the GB/s column is only meaningful on a real chip."""
+    from kaito_tpu.engine.attention import paged_decode_attention
+    from kaito_tpu.engine.ops.decode_attention import (
+        paged_decode_attention_pallas)
+
+    on_cpu = jax.default_backend() == "cpu"
+    if on_cpu:
+        B, H, Hkv, D, ps, P, pmax = 4, 8, 4, 64, 16, 64, 8
+        cdt = jnp.float32
+    else:
+        B, H, Hkv, D, ps, P, pmax = 32, 24, 8, 128, 64, 2048, 32
+        cdt = jnp.bfloat16
+    scale = D ** -0.5
+    key = jax.random.PRNGKey(2)
+    kq, kk, kv, kt, kl = jax.random.split(key, 5)
+    q = jax.random.normal(kq, (B, H, D), cdt)
+    ck = jax.random.normal(kk, (P, ps, Hkv, D), cdt)
+    cv = jax.random.normal(kv, (P, ps, Hkv, D), cdt)
+    pt = jax.random.randint(kt, (B, pmax), 0, P, jnp.int32)
+    lens = jax.random.randint(kl, (B,), ps, pmax * ps, jnp.int32)
+    win = jnp.asarray(1 << 30, jnp.int32)
+
+    # absmax per page per kv head — the granularity the engine writes
+    def quantize(pages):
+        p32 = pages.astype(jnp.float32)
+        s = jnp.max(jnp.abs(p32), axis=(1, 3)) / 127.0      # [P, Hkv]
+        codes = jnp.clip(jnp.round(
+            p32 / jnp.maximum(s, 1e-30)[:, None, :, None]), -127, 127)
+        return codes.astype(jnp.int8), s
+
+    k8, ks = quantize(ck)
+    v8, vs = quantize(cv)
+
+    o_ref = paged_decode_attention(q, ck, cv, pt, lens, scale=scale)
+    o_q = paged_decode_attention_pallas(
+        q, k8, v8, pt, lens, win, scale=scale, k_scale=ks, v_scale=vs,
+        interpret=on_cpu)
+    err = float(jnp.max(jnp.abs(o_q.astype(jnp.float32)
+                                - o_ref.astype(jnp.float32))))
+    print(f"decode int8-KV vs full-precision ref: max abs err = {err:.4f}")
+
+    f_full = jax.jit(lambda q, ck, cv, pt, lens:
+                     paged_decode_attention_pallas(
+                         q, ck, cv, pt, lens, win, scale=scale,
+                         interpret=on_cpu))
+    f_int8 = jax.jit(lambda q, k8, v8, ks, vs, pt, lens:
+                     paged_decode_attention_pallas(
+                         q, k8, v8, pt, lens, win, scale=scale,
+                         k_scale=ks, v_scale=vs, interpret=on_cpu))
+    live_rows = float(jnp.sum(lens)) * Hkv * D
+    live_pages = float(jnp.sum(-(-lens // ps)))
+    rows = (
+        ("f32" if on_cpu else "bf16",
+         lambda: f_full(q, ck, cv, pt, lens),
+         live_rows * 2 * ck.dtype.itemsize),
+        ("int8",
+         lambda: f_int8(q, k8, v8, ks, vs, pt, lens),
+         live_rows * 2 + live_pages * 2 * Hkv * 4),
+    )
+    for name, fn, nbytes in rows:
+        dt = _timeit(fn, iters=iters)
+        print(f"decode[kv-{name}]: {dt * 1e6:8.1f} us/call, "
+              f"live-KV read {nbytes / dt / 1e9:6.1f} GB/s")
+
+
 def bench_prefill(iters: int) -> None:
     from kaito_tpu.engine.attention import prefill_attention
     from kaito_tpu.engine.ops.flash_prefill import flash_prefill_attention
@@ -97,13 +170,16 @@ def bench_prefill(iters: int) -> None:
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--decode", action="store_true")
+    ap.add_argument("--decode-int8", action="store_true")
     ap.add_argument("--prefill", action="store_true")
     ap.add_argument("--iters", type=int, default=50)
     args = ap.parse_args()
-    run_all = not (args.decode or args.prefill)
+    run_all = not (args.decode or args.prefill or args.decode_int8)
     print(f"backend: {jax.default_backend()}, device: {jax.devices()[0]}")
     if args.decode or run_all:
         bench_decode(args.iters)
+    if args.decode_int8 or run_all:
+        bench_decode_int8(args.iters)
     if args.prefill or run_all:
         bench_prefill(args.iters)
 

@@ -44,9 +44,14 @@ def main():
               "report": json.load(open(os.path.join(
                   ckpt, "training_report.json"))),
               "prompts": []}
-    for quant in ("", "int8"):
+    # three pinned modes: full-precision reference, int8 WEIGHTS
+    # (quantization), int8 KV CACHE (kv_dtype) — each drifts for a
+    # different reason, so each pins to its own golden
+    for key, quant, kv_dtype in (("fp32", "", "float32"),
+                                 ("int8", "int8", "float32"),
+                                 ("kv_int8", "", "int8")):
         cfg = EngineConfig(model=args.model, weights_dir=ckpt,
-                           dtype="float32", kv_dtype="float32",
+                           dtype="float32", kv_dtype=kv_dtype,
                            max_model_len=512, max_num_seqs=2,
                            prefill_buckets=(64, 128),
                            enable_prefix_caching=False,
@@ -65,7 +70,6 @@ def main():
                 if entry is None:
                     entry = {"text": text, "prompt_tokens": toks}
                     golden["prompts"].append(entry)
-                key = "int8" if quant else "fp32"
                 entry[key] = {
                     "greedy_tokens": out,
                     "logprobs": [round(float(x), 5)

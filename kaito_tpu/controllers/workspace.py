@@ -180,7 +180,14 @@ class WorkspaceReconciler(Reconciler):
             from kaito_tpu.sku.catalog import topology_chips
 
             target = topology_chips(ws.resource.tpu_topology)
-        plan = plan_parallelism(md, chip, workload=workload, target_chips=target)
+        # an int8 KV pool halves bytes/token, so the planner can fit the
+        # same context on fewer chips (estimator threads the byte width
+        # through kv_bytes_per_token)
+        kv_dtype = ws.metadata.annotations.get(
+            "kaito-tpu.io/kv-cache-dtype", "")
+        plan = plan_parallelism(md, chip, workload=workload,
+                                target_chips=target,
+                                kv_dtype_bytes=1 if kv_dtype == "int8" else 2)
         slice_spec = TPUSliceSpec(
             chip=chip, topology=plan.topology,
             machine_type=ws.resource.instance_type

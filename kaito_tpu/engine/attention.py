@@ -38,6 +38,18 @@ def _layer_view(cache: jax.Array, layer):
     return cache.reshape(Lg * P, *cache.shape[2:]), layer * P
 
 
+def _dequant_gathered(pages, scale_pool, page_tables, base, layer, out_dtype):
+    """Dequantize gathered int8 pages with their per-page-per-head scales.
+
+    ``pages`` is [B, pmax, ps, Hkv, D] straight from the page gather;
+    ``scale_pool`` is the [P, Hkv] / [Lg, P, Hkv] scale tensor, gathered
+    through the same page tables.  Null/garbage pages dequantize to
+    finite junk that the length mask drops, same as the bf16 path."""
+    s_flat, _ = _layer_view(scale_pool, layer)
+    s = s_flat[base + page_tables]                 # [B, pmax, Hkv]
+    return (pages.astype(jnp.float32) * s[:, :, None, :, None]).astype(out_dtype)
+
+
 def prefill_attention(
     q: jax.Array,            # [B, T, H, D]
     k: jax.Array,            # [B, T, Hkv, D]
@@ -89,6 +101,8 @@ def paged_context_attention(
     sliding_window: Optional[jax.Array] = None,
     logit_softcap: Optional[float] = None,
     layer: Optional[jax.Array] = None,
+    k_scale: Optional[jax.Array] = None,   # [P, Hkv] / [Lg, P, Hkv] int8 pools
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Chunked prefill WITH prior context: queries attend over the whole
     paged history (cached prefix + the freshly-written chunk) with
@@ -100,10 +114,13 @@ def paged_context_attention(
     S = pmax * ps
     groups = H // Hkv
 
-    cache_k, base = _layer_view(cache_k, layer)
-    cache_v, _ = _layer_view(cache_v, layer)
-    k = cache_k[base + page_tables]               # [B, pmax, ps, Hkv, D]
-    v = cache_v[base + page_tables]
+    full_k, base = _layer_view(cache_k, layer)
+    full_v, _ = _layer_view(cache_v, layer)
+    k = full_k[base + page_tables]                # [B, pmax, ps, Hkv, D]
+    v = full_v[base + page_tables]
+    if k_scale is not None:
+        k = _dequant_gathered(k, k_scale, page_tables, base, layer, q.dtype)
+        v = _dequant_gathered(v, v_scale, page_tables, base, layer, q.dtype)
     k = k.reshape(B, S, Hkv, D)
     v = v.reshape(B, S, Hkv, D)
     k = _gqa_expand(k, groups)
@@ -173,6 +190,7 @@ def mla_paged_context_attention(
     scale: float,
     kv_lora_rank: int,
     layer: Optional[jax.Array] = None,
+    latent_scale: Optional[jax.Array] = None,   # [P, 1] / [Lg, P, 1]
 ) -> jax.Array:
     """Chunked MLA prefill WITH prior context: chunk queries attend over
     the whole paged latent history (earlier chunks + this one) with
@@ -188,6 +206,10 @@ def mla_paged_context_attention(
 
     cache_latent, base = _layer_view(cache_latent, layer)
     lat = cache_latent[base + page_tables][:, :, :, 0]  # [B, pmax, ps, dl+dr]
+    if latent_scale is not None:
+        s_flat, _ = _layer_view(latent_scale, layer)
+        sl = s_flat[base + page_tables]                 # [B, pmax, 1]
+        lat = lat.astype(jnp.float32) * sl[..., None]
     lat = lat.reshape(B, S, dtot)
     c_kv, k_rope = lat[..., :dl], lat[..., dl:]
 
@@ -222,6 +244,7 @@ def mla_paged_decode_attention(
     scale: float,
     kv_lora_rank: int,
     layer: Optional[jax.Array] = None,
+    latent_scale: Optional[jax.Array] = None,   # [P, 1] / [Lg, P, 1]
 ) -> jax.Array:
     """Decode attention over the paged latent cache.
 
@@ -240,6 +263,10 @@ def mla_paged_decode_attention(
 
     cache_latent, base = _layer_view(cache_latent, layer)
     lat = cache_latent[base + page_tables][:, :, :, 0]  # [B, pmax, ps, dl+dr]
+    if latent_scale is not None:
+        s_flat, _ = _layer_view(latent_scale, layer)
+        sl = s_flat[base + page_tables]                 # [B, pmax, 1]
+        lat = lat.astype(jnp.float32) * sl[..., None]
     lat = lat.reshape(B, S, dtot)
     c_kv, k_rope = lat[..., :dl], lat[..., dl:]
 
@@ -270,6 +297,8 @@ def paged_decode_attention(
     sliding_window: Optional[int] = None,
     logit_softcap: Optional[float] = None,
     layer: Optional[jax.Array] = None,
+    k_scale: Optional[jax.Array] = None,   # [P, Hkv] / [Lg, P, Hkv] int8 pools
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Attend one query token per sequence over its paged KV history
     (pure-JAX reference; the Pallas kernel in engine.ops implements the
@@ -280,10 +309,13 @@ def paged_decode_attention(
     S = pmax * ps
     groups = H // Hkv
 
-    cache_k, base = _layer_view(cache_k, layer)
-    cache_v, _ = _layer_view(cache_v, layer)
-    k = cache_k[base + page_tables]               # [B, pmax, ps, Hkv, D]
-    v = cache_v[base + page_tables]
+    full_k, base = _layer_view(cache_k, layer)
+    full_v, _ = _layer_view(cache_v, layer)
+    k = full_k[base + page_tables]                # [B, pmax, ps, Hkv, D]
+    v = full_v[base + page_tables]
+    if k_scale is not None:
+        k = _dequant_gathered(k, k_scale, page_tables, base, layer, q.dtype)
+        v = _dequant_gathered(v, v_scale, page_tables, base, layer, q.dtype)
     k = k.reshape(B, S, Hkv, D)
     v = v.reshape(B, S, Hkv, D)
 

@@ -25,6 +25,9 @@ class EngineConfig:
     prefill_interleave: int = 2          # decode steps between prefill chunks
     prefill_buckets: tuple[int, ...] = (128, 256, 512, 1024, 2048, 4096)
     dtype: str = "bfloat16"
+    # KV page-pool dtype: "bfloat16" | "float32" | "int8".  int8 stores
+    # quantized codes plus per-page-per-head fp32 scales (kv_cache.py):
+    # ~2x pages at equal HBM and half the decode-step KV read.
     kv_dtype: str = "bfloat16"
     # weight-only quantization: "" (off) | "int8" (per-out-channel
     # symmetric; dense GQA families).  Decode is param-bandwidth-bound,

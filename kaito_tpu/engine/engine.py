@@ -38,7 +38,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from kaito_tpu.engine.config import EngineConfig
-from kaito_tpu.engine.kv_cache import KVCache, create_kv_cache
+from kaito_tpu.engine.kv_cache import (KVCache, create_kv_cache,
+                                       scale_bytes_per_page)
 from kaito_tpu.engine.model import TransformerLM
 from kaito_tpu.engine.sampler import SamplingState, chosen_logprob, sample
 from kaito_tpu.engine.tokenizer import load_tokenizer
@@ -222,6 +223,13 @@ class InferenceEngine:
             self.model.moe_impl = ("dense" if cfg.expert_parallel > 1
                                    else "ragged")
         self.tokenizer = load_tokenizer(self.md.hf_id, arch.vocab_size)
+        if jnp.dtype(cfg.kv_dtype) == jnp.int8 and (
+                cfg.pipeline_parallel > 1 or cfg.sequence_parallel > 1):
+            # the staged 6-dim PP pools and the CP ring prefill don't
+            # carry the page-scale tensors yet
+            raise ValueError(
+                "kv_dtype='int8' is not supported with pipeline_parallel>1 "
+                "or sequence_parallel>1")
         self.pp_exec = None
         if cfg.pipeline_parallel > 1:
             if cfg.pd_enabled and jax.process_count() > 1:
@@ -561,8 +569,14 @@ class InferenceEngine:
             return self.pp_exec.stage_cache(cache)
         if self.mesh is not None:
             sh = self._cache_sharding()
+            k_scale = v_scale = None
+            if cache.k_scale is not None:
+                ssh = self._scale_sharding()
+                k_scale = jax.device_put(cache.k_scale, ssh)
+                v_scale = jax.device_put(cache.v_scale, ssh)
             return KVCache(k=jax.device_put(cache.k, sh),
-                           v=jax.device_put(cache.v, sh))
+                           v=jax.device_put(cache.v, sh),
+                           k_scale=k_scale, v_scale=v_scale)
         return cache
 
     def _param_shardings(self):
@@ -609,6 +623,17 @@ class InferenceEngine:
         if self.md.arch.kv_cache_heads % self.mesh.shape["tensor"] == 0 \
                 and self.md.arch.kv_cache_heads > 1:
             return NamedSharding(self.mesh, P(None, None, None, "tensor"))
+        return NamedSharding(self.mesh, P())
+
+    def _scale_sharding(self):
+        """[L, pages, kv_heads] page-scale pools follow the KV pools:
+        head-sharded iff the pools are."""
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        if self.md.arch.kv_cache_heads % self.mesh.shape["tensor"] == 0 \
+                and self.md.arch.kv_cache_heads > 1:
+            return NamedSharding(self.mesh, P(None, None, "tensor"))
         return NamedSharding(self.mesh, P())
 
     def _make_leaf_transform(self):
@@ -738,6 +763,12 @@ class InferenceEngine:
         else:
             dev = jax.local_devices()[0]
         bpt = self.md.kv_bytes_per_token(jnp.dtype(self.cfg.kv_dtype).itemsize)
+        page_bytes = bpt * self.cfg.page_size
+        if jnp.dtype(self.cfg.kv_dtype) == jnp.int8:
+            # each page also carries two fp32 scale rows (k + v), one
+            # entry per (layer, kv head) — ~0.4% of the int8 page bytes
+            # at typical shapes, but counted so sizing stays exact
+            page_bytes += scale_bytes_per_page(self.md.arch)
         # sizing runs AFTER params are resident (and quantized), so the
         # ACTUAL weight bytes are known — no dtype/quant estimation
         weights = sum(x.nbytes for x in jax.tree.leaves(self.params))
@@ -794,7 +825,7 @@ class InferenceEngine:
                 "estimator_overhead_bytes": int(est_overhead),
                 "source": "static",
             }
-        pages = int(max(free, 0) // (bpt * self.cfg.page_size))
+        pages = int(max(free, 0) // page_bytes)
         cap = self.cfg.max_num_seqs * self.pages_per_seq
         return max(2, min(pages, cap) + 1)
 
@@ -1109,6 +1140,12 @@ class InferenceEngine:
             raise ValueError(
                 f"KV transfer token mismatch: client sent {n_prompt} prompt "
                 f"tokens, staged slab holds {meta.get('n_tokens')}")
+        wire_dt = meta.get("dtype")
+        if wire_dt is not None and np.dtype(wire_dt) != np.dtype(self.cache.k.dtype):
+            raise ValueError(
+                f"KV transfer dtype mismatch: wire {wire_dt} vs pool "
+                f"{np.dtype(self.cache.k.dtype).name} — prefill and decode "
+                f"roles must run the same --kv-cache-dtype")
         shape = meta.get("shape")
         if not strict_shape or not shape:
             return
@@ -1794,15 +1831,16 @@ class InferenceEngine:
         pages — the bytes never touch the host."""
         from kaito_tpu.engine.pd import import_arrays
 
-        meta, (k_dev, v_dev), first = req.kv_device
+        meta, slabs, first = req.kv_device
         n = len(req.prompt_tokens)
         n_prompt_pages = -(-n // self.cfg.page_size)
         slot = self.slots[free_slot]
         with self.tracer.span("kv.import.device", req.trace_id,
                               pages=n_prompt_pages):
+            # 2-tuple (k, v) or 4-tuple (k, v, k_scale, v_scale) slabs
             self.cache = import_arrays(self.cache,
                                        slot.pages[:n_prompt_pages],
-                                       k_dev, v_dev)
+                                       *slabs)
         # drop the slab references (unpin HBM) but KEEP the field as a
         # marker: _evict_slot reads it to keep imported pages out of
         # the shared prefix tree, like the other import kinds
@@ -1849,9 +1887,9 @@ class InferenceEngine:
                         n_pages = -(-n // self.cfg.page_size)
                         with self.tracer.span("kv.import.chunked",
                                               req.trace_id, pages=n_pages):
-                            k, v = ci.full_arrays()
                             self.cache = import_arrays(
-                                self.cache, slot.pages[:n_pages], k, v)
+                                self.cache, slot.pages[:n_pages],
+                                *ci.full_arrays())
                         slot.importing = False
                         self._begin_decode(i, ci.first_token, n)
                         did = True
@@ -2104,8 +2142,16 @@ class InferenceEngine:
                 k_pages, v_pages = gather_pages(
                     self.cache.k, self.cache.v, jnp.asarray(ids),
                     page_axis=page_axis)
+                ks_pages = vs_pages = None
+                if self.cache.k_scale is not None:
+                    # scale pools share the page axis; same gather
+                    ks_pages, vs_pages = gather_pages(
+                        self.cache.k_scale, self.cache.v_scale,
+                        jnp.asarray(ids), page_axis=1)
                 stored = self.host_kv.put(req.req_id, k_pages, v_pages,
-                                          written, page_axis=page_axis)
+                                          written, page_axis=page_axis,
+                                          k_scale=ks_pages,
+                                          v_scale=vs_pages)
             if stored:
                 self.counters["host_kv_spilled_pages_total"] += n_pages
             # else: entry can never fit; resume recomputes
@@ -2160,7 +2206,19 @@ class InferenceEngine:
         with self.tracer.span("kv.restore", req.trace_id, pages=n_pages):
             k, v = self._scatter_pages_fn()(self.cache.k, self.cache.v,
                                             ids, ek, ev)
-            self.cache = KVCache(k=k, v=v)
+            ks, vs = self.cache.k_scale, self.cache.v_scale
+            if entry.k_scale is not None and ks is not None:
+                eks, evs = entry.k_scale, entry.v_scale
+                if isinstance(eks, _HostShards):
+                    eks, evs = eks.rebuild(), evs.rebuild()
+                elif mesh is not None:
+                    from jax.sharding import NamedSharding
+                    from jax.sharding import PartitionSpec as P
+
+                    repl = NamedSharding(mesh, P())
+                    eks, evs = (jax.device_put(x, repl) for x in (eks, evs))
+                ks, vs = self._scatter_scales_fn()(ks, vs, ids, eks, evs)
+            self.cache = KVCache(k=k, v=v, k_scale=ks, v_scale=vs)
         self.counters["host_kv_restored_pages_total"] += n_pages
         n = len(req.resume_tokens())
         slot.prefilling = False
@@ -2201,6 +2259,24 @@ class InferenceEngine:
             fn = jax.jit(_partial(_scatter_impl, page_axis=page_axis),
                          donate_argnums=(0, 1), **kw)
             self._scatter_jit = fn
+        return fn
+
+    def _scatter_scales_fn(self):
+        """Restore-scatter for the [L, pages, Hkv] scale pools (int8 KV
+        mode only; PP is gated off so page_axis is always 1)."""
+        fn = getattr(self, "_scatter_scales_jit", None)
+        if fn is None:
+            from functools import partial as _partial
+
+            from kaito_tpu.engine.host_offload import _scatter_impl
+
+            kw = {}
+            if self.mesh is not None:
+                sh = self._scale_sharding()
+                kw["out_shardings"] = (sh, sh)
+            fn = jax.jit(_partial(_scatter_impl, page_axis=1),
+                         donate_argnums=(0, 1), **kw)
+            self._scatter_scales_jit = fn
         return fn
 
     def _newest_slot(self) -> Optional[int]:

@@ -68,6 +68,9 @@ class HostKVEntry:
     written: int          # tokens whose KV the pages hold
     nbytes: int
     n_pages: int          # padded page-bucket size (layout-independent)
+    k_scale: object = None   # fp32 [L, n_pages, Hkv] when pool is int8
+    v_scale: object = None   # (spilled/restored with the codes — int8
+                             # pages are meaningless without them)
 
 
 class HostKVPool:
@@ -90,10 +93,12 @@ class HostKVPool:
         return len(self._entries)
 
     def put(self, req_id: str, k, v, written: int,
-            page_axis: int = 1) -> bool:
+            page_axis: int = 1, k_scale=None, v_scale=None) -> bool:
         """Store a spilled sequence; returns False if it can never fit."""
         self.discard(req_id)   # same-key overwrite must not double-count
         nbytes = k.nbytes + v.nbytes
+        if k_scale is not None:
+            nbytes += k_scale.nbytes + v_scale.nbytes
         if nbytes > self.max_bytes:
             return False
         while self.used_bytes + nbytes > self.max_bytes and self._entries:
@@ -112,13 +117,20 @@ class HostKVPool:
             jax.block_until_ready((k, v))
             nbytes = max(1, nbytes // jax.process_count())
             k, v = _HostShards(k), _HostShards(v)
+            if k_scale is not None:
+                jax.block_until_ready((k_scale, v_scale))
+                k_scale = _HostShards(k_scale)
+                v_scale = _HostShards(v_scale)
         elif self._host_dev is not None:
             # async D2H: enqueued ahead of any later donating step
             k = jax.device_put(k, self._host_dev)
             v = jax.device_put(v, self._host_dev)
+            if k_scale is not None:
+                k_scale = jax.device_put(k_scale, self._host_dev)
+                v_scale = jax.device_put(v_scale, self._host_dev)
         self._entries[req_id] = HostKVEntry(
             k=k, v=v, written=written, nbytes=nbytes,
-            n_pages=n_pages)
+            n_pages=n_pages, k_scale=k_scale, v_scale=v_scale)
         self.used_bytes += nbytes
         self.spilled_pages += n_pages
         return True

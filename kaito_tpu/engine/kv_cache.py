@@ -21,6 +21,18 @@ layout conversion at phi-4-mini bench shapes.)
 Page 0 is reserved as the null page: unused page-table slots point at
 it, so gathers are always in-bounds and masking is done by length, not
 by index validity.
+
+Quantized mode (``kv_dtype="int8"``): the pools store int8 codes plus a
+per-page-per-head fp32 scale tensor ``[L, num_pages, kv_heads]`` carried
+in the same pytree.  Writes quantize with a *rescale-on-grow* fold: the
+written tile's absmax is folded into the page scale
+(sigma_new = max(sigma_old, absmax/127)) and, when the scale grows, the
+page's existing codes are re-quantized at the new scale in the same
+scatter — so dequantization ``code * sigma`` stays correct for every
+token a page holds, not just the last-written one.  Reads dequantize
+either inside the Pallas decode kernel (scales ride the page DMA) or
+after the gather on the pure-JAX paths.  The null page accumulates
+garbage codes AND garbage scales by design; length masking hides both.
 """
 
 from __future__ import annotations
@@ -43,6 +55,11 @@ class KVCache:
 
     k: jax.Array  # [L, num_pages, page_size, kv_heads, head_dim]
     v: jax.Array
+    # Per-page-per-head dequantization scales, fp32 [L, num_pages, kv_heads];
+    # None for non-quantized pools (None is a valid empty pytree leaf, so
+    # the bf16 mode's scan carries and donation are untouched).
+    k_scale: Optional[jax.Array] = None
+    v_scale: Optional[jax.Array] = None
 
     @property
     def num_pages(self) -> int:
@@ -51,6 +68,19 @@ class KVCache:
     @property
     def page_size(self) -> int:
         return self.k.shape[2]
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
+
+def kv_cache_is_quantized(dtype) -> bool:
+    return jnp.dtype(dtype) == jnp.int8
+
+
+def scale_bytes_per_page(arch: ModelArch) -> int:
+    """HBM overhead of the two fp32 scale rows one page carries."""
+    return 2 * arch.num_layers * arch.kv_cache_heads * 4
 
 
 def create_kv_cache(
@@ -61,12 +91,31 @@ def create_kv_cache(
 ) -> KVCache:
     shape = (arch.num_layers, num_pages, page_size, arch.kv_cache_heads,
              arch.kv_cache_dim)
+    k_scale = v_scale = None
+    if kv_cache_is_quantized(dtype):
+        # Zero scales dequantize the zeroed pool to exact zeros; scales
+        # only grow as real tokens land in a page.
+        sshape = (arch.num_layers, num_pages, arch.kv_cache_heads)
+        k_scale = jnp.zeros(sshape, jnp.float32)
+        v_scale = jnp.zeros(sshape, jnp.float32)
     if arch.attention_kind.value == "MLA":
         # MLA caches one latent stream; `k` holds it, `v` is a
         # zero-size placeholder keeping the pytree uniform
         return KVCache(k=jnp.zeros(shape, dtype),
-                       v=jnp.zeros(shape[:-1] + (0,), dtype))
-    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+                       v=jnp.zeros(shape[:-1] + (0,), dtype),
+                       k_scale=k_scale, v_scale=v_scale)
+    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
+                   k_scale=k_scale, v_scale=v_scale)
+
+
+def _safe(s: jax.Array) -> jax.Array:
+    """Guard divisions by a not-yet-grown (zero) page scale."""
+    return jnp.where(s > 0, s, 1.0)
+
+
+def dequantize_pages(pages: jax.Array, scale: jax.Array) -> jax.Array:
+    """[..., ps, Hkv, D] int8 codes x [..., Hkv] scales -> fp32."""
+    return pages.astype(jnp.float32) * scale[..., None, :, None]
 
 
 def write_prefill_tokens(
@@ -119,3 +168,129 @@ def write_decode_tokens(
     if layer is None:
         return cache_layer.at[page_idx, offset].set(new)
     return cache_layer.at[layer, page_idx, offset].set(new)
+
+
+def _requantize(pages: jax.Array, old: jax.Array, s_new: jax.Array) -> jax.Array:
+    """Re-express existing int8 codes at a grown page scale.
+
+    ``ratio = old/new <= 1`` so the rescaled codes stay in [-127, 127];
+    when the scale didn't grow ratio is exactly 1.0 and the round-trip
+    is the identity (no drift on repeated writes to the same page)."""
+    ratio = jnp.where(s_new > 0, old / _safe(s_new), 1.0)
+    scaled = pages.astype(jnp.float32) * ratio[..., None, :, None]
+    return jnp.clip(jnp.round(scaled), -127, 127)
+
+
+def write_decode_tokens_q(
+    cache_layer: jax.Array,       # int8 [Lg, P, ps, Hkv, D] (or unstacked)
+    scale_layer: jax.Array,       # fp32 [Lg, P, Hkv] (or [P, Hkv])
+    new: jax.Array,               # [B, Hkv, D] one token per sequence
+    page_tables: jax.Array,       # [B, pages_per_seq]
+    positions: jax.Array,         # [B]
+    page_size: int,
+    active: Optional[jax.Array] = None,
+    layer: Optional[jax.Array] = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Quantizing counterpart of :func:`write_decode_tokens`.
+
+    Gathers each target page + its scale, folds the new token's absmax
+    into the scale (rescaling the page's existing codes if it grew),
+    inserts the quantized token row, and scatters both back.  Inactive
+    rows hit the null page — its codes and scale become garbage, which
+    is fine: reads mask by length and scales stay finite."""
+    page_idx = jnp.take_along_axis(
+        page_tables, (positions // page_size)[:, None], axis=1)[:, 0]
+    if active is not None:
+        page_idx = jnp.where(active, page_idx, NULL_PAGE)
+    offset = positions % page_size
+
+    lidx = (layer,) if layer is not None else ()
+    pages = cache_layer[lidx + (page_idx,)]        # [B, ps, Hkv, D]
+    old = scale_layer[lidx + (page_idx,)]          # [B, Hkv]
+
+    new32 = new.astype(jnp.float32)
+    cand = jnp.max(jnp.abs(new32), axis=-1) / 127.0          # [B, Hkv]
+    s_new = jnp.maximum(old, cand)
+    merged = _requantize(pages, old, s_new)
+    q_new = jnp.clip(jnp.round(new32 / _safe(s_new)[..., None]), -127, 127)
+
+    ps = cache_layer.shape[-3]
+    at_row = jnp.arange(ps, dtype=jnp.int32)[None, :] == offset[:, None]
+    merged = jnp.where(at_row[..., None, None], q_new[:, None], merged)
+    merged = merged.astype(cache_layer.dtype)
+
+    cache_layer = cache_layer.at[lidx + (page_idx,)].set(merged)
+    scale_layer = scale_layer.at[lidx + (page_idx,)].set(s_new)
+    return cache_layer, scale_layer
+
+
+def write_prefill_tokens_q(
+    cache_layer: jax.Array,       # int8 [Lg, P, ps, Hkv, D] (or unstacked)
+    scale_layer: jax.Array,       # fp32 [Lg, P, Hkv] (or [P, Hkv])
+    new: jax.Array,               # [B, T, Hkv, D]
+    page_tables: jax.Array,       # [B, pages_per_seq]
+    start_pos: jax.Array,         # [B]
+    true_lens: jax.Array,         # [B]
+    page_size: int,
+    layer: Optional[jax.Array] = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Quantizing counterpart of :func:`write_prefill_tokens`.
+
+    A T-token chunk starting mid-page spans at most ceil(T/ps)+1 page
+    slots, so the update is reformulated page-wise: gather that span,
+    fold per-segment absmaxes into the span's scales, requantize what
+    the pages already held, insert the new tokens at the grown scales,
+    and scatter the span back.  Invalid (padding) tokens are routed to
+    an out-of-bounds segment — JAX drops OOB scatter indices — and are
+    excluded from the absmax fold."""
+    B, T = new.shape[:2]
+    ps = page_size
+    n_pg = (T + ps - 1) // ps + 1
+
+    t = jnp.arange(T, dtype=jnp.int32)[None, :]
+    pos = start_pos[:, None] + t                                  # [B, T]
+    valid = t < true_lens[:, None]
+    first_slot = (start_pos // ps).astype(jnp.int32)              # [B]
+    seg = pos // ps - first_slot[:, None]                         # [B, T] in [0, n_pg)
+
+    pmax = page_tables.shape[1]
+    slot_ids = first_slot[:, None] + jnp.arange(n_pg, dtype=jnp.int32)[None, :]
+    in_range = slot_ids < pmax
+    span_pages = jnp.take_along_axis(
+        page_tables, jnp.clip(slot_ids, 0, pmax - 1), axis=1)     # [B, n_pg]
+    span_pages = jnp.where(in_range, span_pages, NULL_PAGE)
+
+    lidx = (layer,) if layer is not None else ()
+    pages = cache_layer[lidx + (span_pages,)]      # [B, n_pg, ps, Hkv, D]
+    old = scale_layer[lidx + (span_pages,)]        # [B, n_pg, Hkv]
+
+    new32 = new.astype(jnp.float32)
+    tokmax = jnp.max(jnp.abs(new32), axis=-1)                     # [B, T, Hkv]
+    seg_onehot = (seg[:, :, None] == jnp.arange(n_pg)[None, None, :]) \
+        & valid[:, :, None]                                        # [B, T, n_pg]
+    cand = jnp.max(
+        jnp.where(seg_onehot[..., None], tokmax[:, :, None, :], 0.0),
+        axis=1) / 127.0                                            # [B, n_pg, Hkv]
+    s_new = jnp.maximum(old, cand)
+    merged = _requantize(pages, old, s_new)
+
+    s_tok = jnp.take_along_axis(
+        s_new, jnp.clip(seg, 0, n_pg - 1)[..., None], axis=1)     # [B, T, Hkv]
+    q_tok = jnp.clip(jnp.round(new32 / _safe(s_tok)[..., None]), -127, 127)
+
+    # Insert each token into its page-span slot; invalid tokens get
+    # segment n_pg, which is out of bounds for axis 1 -> dropped.
+    b_idx = jnp.broadcast_to(jnp.arange(B, dtype=jnp.int32)[:, None], (B, T))
+    seg_i = jnp.where(valid, seg, n_pg)
+    offset = pos % ps
+    merged = merged.at[
+        b_idx.reshape(-1), seg_i.reshape(-1), offset.reshape(-1)
+    ].set(q_tok.reshape(B * T, *q_tok.shape[2:]))
+    merged = merged.astype(cache_layer.dtype)
+
+    flat_pages = span_pages.reshape(-1)
+    cache_layer = cache_layer.at[lidx + (flat_pages,)].set(
+        merged.reshape(B * n_pg, *merged.shape[2:]))
+    scale_layer = scale_layer.at[lidx + (flat_pages,)].set(
+        s_new.reshape(B * n_pg, s_new.shape[-1]))
+    return cache_layer, scale_layer

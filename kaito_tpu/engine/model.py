@@ -26,7 +26,10 @@ import jax.numpy as jnp
 
 from kaito_tpu.engine import attention as attn
 from kaito_tpu.engine import nn
-from kaito_tpu.engine.kv_cache import KVCache, write_decode_tokens, write_prefill_tokens
+from kaito_tpu.engine.kv_cache import (KVCache, write_decode_tokens,
+                                       write_decode_tokens_q,
+                                       write_prefill_tokens,
+                                       write_prefill_tokens_q)
 from kaito_tpu.models.metadata import AttentionKind, ModelArch
 
 VOCAB_ALIGN = 128
@@ -295,7 +298,7 @@ class TransformerLM:
     # MLA (DeepSeek-style latent attention)
     # ------------------------------------------------------------------
 
-    def _mla_attention(self, h, p, ck, cv, li, mode, *, positions,
+    def _mla_attention(self, h, p, ck, cv, li, ks, vs, mode, *, positions,
                        page_tables, lengths, true_lens, active,
                        start_pos=None):
         """Latent attention: project to a shared compressed KV latent,
@@ -303,7 +306,11 @@ class TransformerLM:
         or absorb projections into the query (decode).
 
         ``ck`` is the full layer-group latent cache [Lg, P, ps, 1, dl+dr]
-        riding the layer scan as a carry; ``li`` selects this layer."""
+        riding the layer scan as a carry; ``li`` selects this layer.
+        ``ks``/``vs`` are the group's page-scale pools when the latent
+        stream is int8-quantized (None otherwise); only ``ks`` is live —
+        MLA has a single cached stream — but both ride the carry so the
+        pytree shape matches the GQA path."""
         a = self.arch
         B, T, E = h.shape
         H = a.num_heads
@@ -337,30 +344,42 @@ class TransformerLM:
             ps = ck.shape[-3]
             start = (start_pos if start_pos is not None
                      else jnp.zeros((B,), jnp.int32))
-            ck = write_prefill_tokens(ck, latent[:, :, None, :], page_tables,
-                                      start, true_lens, ps, layer=li)
+            if ks is not None:
+                ck, ks = write_prefill_tokens_q(
+                    ck, ks, latent[:, :, None, :], page_tables,
+                    start, true_lens, ps, layer=li)
+            else:
+                ck = write_prefill_tokens(ck, latent[:, :, None, :],
+                                          page_tables, start, true_lens, ps,
+                                          layer=li)
             if start_pos is not None:
                 # chunked prefill: attend over the paged latent history
                 # (earlier chunks) + this chunk, absolute positions
                 out = attn.mla_paged_context_attention(
                     q_nope, q_rope, ck, page_tables, start, true_lens,
                     p["kv_b_k"], p["kv_b_v"], scale=self._scale,
-                    kv_lora_rank=dl, layer=li)
+                    kv_lora_rank=dl, layer=li, latent_scale=ks)
             else:
                 out = attn.mla_prefill_attention(
                     q_nope, q_rope, c_kv, k_rope, p["kv_b_k"], p["kv_b_v"],
                     scale=self._scale, true_len=true_lens)
         else:
             ps = ck.shape[-3]
-            ck = write_decode_tokens(ck, latent[:, 0][:, None, :], page_tables,
-                                     positions[:, 0], ps, active, layer=li)
+            if ks is not None:
+                ck, ks = write_decode_tokens_q(
+                    ck, ks, latent[:, 0][:, None, :], page_tables,
+                    positions[:, 0], ps, active, layer=li)
+            else:
+                ck = write_decode_tokens(ck, latent[:, 0][:, None, :],
+                                         page_tables, positions[:, 0], ps,
+                                         active, layer=li)
             out = attn.mla_paged_decode_attention(
                 q_nope[:, 0], q_rope[:, 0], ck, page_tables, lengths,
                 p["kv_b_k"], p["kv_b_v"], scale=self._scale,
-                kv_lora_rank=dl, layer=li)[:, None]
+                kv_lora_rank=dl, layer=li, latent_scale=ks)[:, None]
         dv = a.v_head_dim or a.head_dim
         attn_out = nn.linear(out.reshape(B, T, H * dv), p["o"])
-        return attn_out, ck, cv
+        return attn_out, ck, cv, ks, vs
 
     # ------------------------------------------------------------------
     # Layer body (shared by prefill and decode via mode switch)
@@ -422,28 +441,32 @@ class TransformerLM:
 
     def _layer(self, x, p, ck, cv, li, window, moe, mode, *,
                positions, page_tables, lengths, true_lens, active,
-               start_pos=None, lora=None, lora_ids=None):
-        """One transformer block. Returns (x, ck, cv).
+               start_pos=None, lora=None, lora_ids=None,
+               ks=None, vs=None):
+        """One transformer block. Returns (x, ck, cv, ks, vs).
 
         ``ck``/``cv`` are the FULL layer-group page pools
         [Lg, P, ps, Hkv, D] riding the layer scan as a carry; ``li`` is
         this layer's index into them.  Writes are in-place scatters on
         the carry and attention reads gather straight from the big
         buffer — neither materializes a per-layer slice (which cost
-        ~14 ms/step when the cache rode the scan as stacked ys)."""
+        ~14 ms/step when the cache rode the scan as stacked ys).
+        ``ks``/``vs`` are the group's [Lg, P, Hkv] page-scale pools when
+        the KV pools are int8-quantized, riding the same carry; None in
+        bf16 mode."""
         a = self.arch
         B, T, E = x.shape
         h = self._norm(x, p, "attn_norm")
         if self.is_mla:
-            attn_out, ck, cv = self._mla_attention(
-                h, p, ck, cv, li, mode, positions=positions,
+            attn_out, ck, cv, ks, vs = self._mla_attention(
+                h, p, ck, cv, li, ks, vs, mode, positions=positions,
                 page_tables=page_tables, lengths=lengths,
                 true_lens=true_lens, active=active, start_pos=start_pos)
             if a.parallel_residual:
-                return x + attn_out + self._mlp(h, p, moe), ck, cv
+                return x + attn_out + self._mlp(h, p, moe), ck, cv, ks, vs
             x = x + attn_out
             h2 = self._norm(x, p, "mlp_norm")
-            return x + self._mlp(h2, p, moe), ck, cv
+            return x + self._mlp(h2, p, moe), ck, cv, ks, vs
         q, k_new, v_new = self._attn_qkv(h, p, positions, window,
                                          lora=lora, lora_ids=lora_ids)
         ps = ck.shape[-3]
@@ -462,10 +485,16 @@ class TransformerLM:
 
             mesh, axis_name, head_axis, q_tile = self.cp
             start = jnp.zeros((B,), jnp.int32)
-            ck = write_prefill_tokens(ck, k_new, page_tables, start,
-                                      true_lens, ps, layer=li)
-            cv = write_prefill_tokens(cv, v_new, page_tables, start,
-                                      true_lens, ps, layer=li)
+            if ks is not None:
+                ck, ks = write_prefill_tokens_q(ck, ks, k_new, page_tables,
+                                                start, true_lens, ps, layer=li)
+                cv, vs = write_prefill_tokens_q(cv, vs, v_new, page_tables,
+                                                start, true_lens, ps, layer=li)
+            else:
+                ck = write_prefill_tokens(ck, k_new, page_tables, start,
+                                          true_lens, ps, layer=li)
+                cv = write_prefill_tokens(cv, v_new, page_tables, start,
+                                          true_lens, ps, layer=li)
             out = ring_attention(
                 q, k_new, v_new, mesh, axis_name, scale=self._scale,
                 causal=True, sliding_window=window,
@@ -474,16 +503,23 @@ class TransformerLM:
         elif mode == "prefill":
             start = (start_pos if start_pos is not None
                      else jnp.zeros((B,), jnp.int32))
-            ck = write_prefill_tokens(ck, k_new, page_tables, start,
-                                      true_lens, ps, layer=li)
-            cv = write_prefill_tokens(cv, v_new, page_tables, start,
-                                      true_lens, ps, layer=li)
+            if ks is not None:
+                ck, ks = write_prefill_tokens_q(ck, ks, k_new, page_tables,
+                                                start, true_lens, ps, layer=li)
+                cv, vs = write_prefill_tokens_q(cv, vs, v_new, page_tables,
+                                                start, true_lens, ps, layer=li)
+            else:
+                ck = write_prefill_tokens(ck, k_new, page_tables, start,
+                                          true_lens, ps, layer=li)
+                cv = write_prefill_tokens(cv, v_new, page_tables, start,
+                                          true_lens, ps, layer=li)
             if start_pos is not None:
                 # chunk attends over cached context + itself (prefix reuse)
                 out = attn.paged_context_attention(
                     q, ck, cv, page_tables, start, true_lens,
                     scale=self._scale, sliding_window=window,
-                    logit_softcap=a.attn_logit_softcap, layer=li)
+                    logit_softcap=a.attn_logit_softcap, layer=li,
+                    k_scale=ks, v_scale=vs)
             elif self.attn_impl == "pallas":
                 from kaito_tpu.engine.ops.flash_prefill import (
                     flash_prefill_attention)
@@ -498,10 +534,18 @@ class TransformerLM:
                     sliding_window=window, logit_softcap=a.attn_logit_softcap,
                     true_len=true_lens)
         else:
-            ck = write_decode_tokens(ck, k_new[:, 0], page_tables,
-                                     positions[:, 0], ps, active, layer=li)
-            cv = write_decode_tokens(cv, v_new[:, 0], page_tables,
-                                     positions[:, 0], ps, active, layer=li)
+            if ks is not None:
+                ck, ks = write_decode_tokens_q(ck, ks, k_new[:, 0], page_tables,
+                                               positions[:, 0], ps, active,
+                                               layer=li)
+                cv, vs = write_decode_tokens_q(cv, vs, v_new[:, 0], page_tables,
+                                               positions[:, 0], ps, active,
+                                               layer=li)
+            else:
+                ck = write_decode_tokens(ck, k_new[:, 0], page_tables,
+                                         positions[:, 0], ps, active, layer=li)
+                cv = write_decode_tokens(cv, v_new[:, 0], page_tables,
+                                         positions[:, 0], ps, active, layer=li)
             if self.attn_impl == "pallas":
                 from kaito_tpu.engine.ops.decode_attention import (
                     paged_decode_attention_pallas)
@@ -510,12 +554,13 @@ class TransformerLM:
                 out = paged_decode_attention_pallas(
                     q[:, 0], ck, cv, page_tables, lengths,
                     jnp.asarray(win, jnp.int32), scale=self._scale,
-                    softcap=a.attn_logit_softcap, layer=li)
+                    softcap=a.attn_logit_softcap, layer=li,
+                    k_scale=ks, v_scale=vs)
             else:
                 out = attn.paged_decode_attention(
                     q[:, 0], ck, cv, page_tables, lengths, scale=self._scale,
                     sliding_window=window, logit_softcap=a.attn_logit_softcap,
-                    layer=li)
+                    layer=li, k_scale=ks, v_scale=vs)
             out = out[:, None]
         o_in = out.reshape(B, T, a.num_heads * a.head_dim)
         attn_out = nn.linear(o_in, p["o"]) + nn.lora_delta(o_in, p, "o", self.lora_scaling) \
@@ -525,7 +570,7 @@ class TransformerLM:
 
         if a.parallel_residual:
             mlp_out = self._mlp(h, p, moe, lora=lora, lora_ids=lora_ids)
-            return x + attn_out + mlp_out, ck, cv
+            return x + attn_out + mlp_out, ck, cv, ks, vs
 
         if a.pre_post_norm:
             attn_out = self._norm(attn_out, p, "post_attn_norm")
@@ -534,7 +579,7 @@ class TransformerLM:
         mlp_out = self._mlp(h2, p, moe, lora=lora, lora_ids=lora_ids)
         if a.pre_post_norm:
             mlp_out = self._norm(mlp_out, p, "post_mlp_norm")
-        return x + mlp_out, ck, cv
+        return x + mlp_out, ck, cv, ks, vs
 
     # ------------------------------------------------------------------
     # Forward passes
@@ -544,7 +589,7 @@ class TransformerLM:
                     positions, page_tables, lengths, true_lens, active,
                     remat: bool = False, start_pos=None, adapter_ids=None):
         serve_lora = params.get("serve_lora") if mode != "train" else None
-        new_k, new_v = [], []
+        new_k, new_v, new_ks, new_vs = [], [], [], []
         for g in self.groups:
             stack = params[g.name]
             flags = self._window_flags(g.start, g.count)
@@ -569,23 +614,30 @@ class TransformerLM:
             # of a 31 ms decode step on a v5e chip.)
             ck_g = cache.k[g.start:g.start + g.count]
             cv_g = cache.v[g.start:g.start + g.count]
+            # scale pools (int8 KV mode) ride the same carry; None is a
+            # valid empty pytree leaf so the bf16 scan is unchanged
+            ks_g = (cache.k_scale[g.start:g.start + g.count]
+                    if cache.k_scale is not None else None)
+            vs_g = (cache.v_scale[g.start:g.start + g.count]
+                    if cache.v_scale is not None else None)
             # per-request adapters ride the scan as an extra [L, n, ...]
             # stack (None for groups without one, e.g. MoE)
             lora_g = serve_lora.get(g.name) if serve_lora else None
             has_lora = bool(lora_g)
 
             def body(carry, xs, moe=g.moe, has_lora=has_lora):
-                h, ck_g, cv_g = carry
+                h, ck_g, cv_g, ks_g, vs_g = carry
                 items = list(xs)
                 li, p = items[0], items[1]
                 lora_l = items[2] if has_lora else None
                 window = items[-1] if flags is not None else None
-                h, ck_g, cv_g = self._layer(
+                h, ck_g, cv_g, ks_g, vs_g = self._layer(
                     h, p, ck_g, cv_g, li, window, moe, mode,
                     positions=positions, page_tables=page_tables,
                     lengths=lengths, true_lens=true_lens, active=active,
-                    start_pos=start_pos, lora=lora_l, lora_ids=adapter_ids)
-                return (h, ck_g, cv_g), None
+                    start_pos=start_pos, lora=lora_l, lora_ids=adapter_ids,
+                    ks=ks_g, vs=vs_g)
+                return (h, ck_g, cv_g, ks_g, vs_g), None
 
             # scan length follows the actual stack: pipeline stages pass
             # stage-local views whose leading axis is a fraction of the
@@ -604,13 +656,22 @@ class TransformerLM:
                         f"sliding-window pattern ({pat}); per-stage window "
                         f"flags are not implemented")
                 xs = xs + (flags[:Lg],)
-            (x, ck_new, cv_new), _ = jax.lax.scan(body, (x, ck_g, cv_g), xs)
+            (x, ck_new, cv_new, ks_new, vs_new), _ = jax.lax.scan(
+                body, (x, ck_g, cv_g, ks_g, vs_g), xs)
             new_k.append(ck_new)
             new_v.append(cv_new)
+            new_ks.append(ks_new)
+            new_vs.append(vs_new)
         if mode == "train":
             return x, None
-        cache = KVCache(k=jnp.concatenate(new_k) if len(new_k) > 1 else new_k[0],
-                        v=jnp.concatenate(new_v) if len(new_v) > 1 else new_v[0])
+
+        def _cat(parts):
+            if parts and parts[0] is None:
+                return None
+            return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+
+        cache = KVCache(k=_cat(new_k), v=_cat(new_v),
+                        k_scale=_cat(new_ks), v_scale=_cat(new_vs))
         return x, cache
 
     def _layer_train(self, x, p, window, moe, *, positions, true_lens):
@@ -619,10 +680,10 @@ class TransformerLM:
         B, T, E = x.shape
         h = self._norm(x, p, "attn_norm")
         if self.is_mla:
-            attn_out, _, _ = self._mla_attention(
-                h, p, None, None, None, "train", positions=positions,
-                page_tables=None, lengths=None, true_lens=true_lens,
-                active=None)
+            attn_out, _, _, _, _ = self._mla_attention(
+                h, p, None, None, None, None, None, "train",
+                positions=positions, page_tables=None, lengths=None,
+                true_lens=true_lens, active=None)
             if a.parallel_residual:
                 return x + attn_out + self._mlp(h, p, moe)
             x = x + attn_out

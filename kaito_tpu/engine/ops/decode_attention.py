@@ -49,6 +49,11 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 N_BUF = 4
 
+# jax renamed TPUCompilerParams -> CompilerParams; accept either so the
+# kernel loads against the pallas version this image ships
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
+
 
 def _decode_kernel(
     # scalar prefetch
@@ -60,17 +65,21 @@ def _decode_kernel(
     q_ref,             # [1, H, D] VMEM (pre-scaled)
     k_hbm,             # [Lg, P, ps*Hkv, D] ANY/HBM (full group stack)
     v_hbm,
-    # outputs
-    o_ref,             # [1, H, D] VMEM
-    # scratch
-    k_buf,             # [N_BUF, ps*Hkv, D] VMEM
-    v_buf,
-    sems,              # [N_BUF, 2] DMA semaphores
-    *,
+    # quantized mode only: [Lg, P, 1, ps*Hkv] fp32 dequant rows, then
+    # outputs + scratch (+[N_BUF, 1, ps*Hkv] scale ring / extra sems)
+    *rest,
     page_size: int,
     num_kv: int,
     softcap: Optional[float],
+    quantized: bool,
 ):
+    if quantized:
+        (ks_hbm, vs_hbm, o_ref,
+         k_buf, v_buf, sems, ks_buf, vs_buf, ssems) = rest
+    else:
+        o_ref, k_buf, v_buf, sems = rest
+        ks_hbm = vs_hbm = ks_buf = vs_buf = ssems = None
+
     b = pl.program_id(0)
     length = lengths_ref[b]
     window = window_ref[0]
@@ -90,11 +99,27 @@ def _decode_kernel(
             v_hbm.at[li, page_tables_ref[b, p]], v_buf.at[slot],
             sems.at[slot, 1])
 
+    def ks_dma(slot, p):
+        return pltpu.make_async_copy(
+            ks_hbm.at[li, page_tables_ref[b, p]], ks_buf.at[slot],
+            ssems.at[slot, 0])
+
+    def vs_dma(slot, p):
+        return pltpu.make_async_copy(
+            vs_hbm.at[li, page_tables_ref[b, p]], vs_buf.at[slot],
+            ssems.at[slot, 1])
+
+    def start_page(slot, p):
+        k_dma(slot, p).start()
+        v_dma(slot, p).start()
+        if quantized:
+            ks_dma(slot, p).start()
+            vs_dma(slot, p).start()
+
     for i in range(N_BUF):
         @pl.when(i < n_pages)
         def _(i=i):
-            k_dma(i, i).start()
-            v_dma(i, i).start()
+            start_page(i, i)
 
     q2 = q_ref[0]                                  # [H, D]
     # score-panel coordinates: column t*Hkv + h' is page row t, kv head
@@ -112,10 +137,19 @@ def _decode_kernel(
         v_dma(slot, p).wait()
         k2 = k_buf[slot]                           # [ps*Hkv, D]
         v2 = v_buf[slot]
+        if quantized:
+            ks_dma(slot, p).wait()
+            vs_dma(slot, p).wait()
+            # Per-column scales factor out of the D-contraction exactly:
+            # fold sigma_k into the scores and sigma_v into the probs, so
+            # the int8 dots match the dequantize-then-dot fallback.
+            k2 = k2.astype(q2.dtype)
 
         s = jax.lax.dot_general(
             q2, k2, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)    # [H, ps*Hkv]
+        if quantized:
+            s = s * ks_buf[slot]                   # [1, ps*Hkv] broadcast
         if softcap:
             s = jnp.tanh(s / softcap) * softcap
         pos = p * page_size + col_t
@@ -126,6 +160,9 @@ def _decode_kernel(
         alpha = jnp.exp(m - m_new)
         p_ij = jnp.exp(s - m_new)
         l_new = l * alpha + jnp.sum(p_ij, axis=1, keepdims=True)
+        if quantized:
+            p_ij = p_ij * vs_buf[slot]
+            v2 = v2.astype(jnp.float32)
         pv = jax.lax.dot_general(
             p_ij.astype(v2.dtype), v2, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)    # [H, D]
@@ -133,8 +170,7 @@ def _decode_kernel(
         # refill the slot we just consumed
         @pl.when(p + N_BUF < n_pages)
         def _():
-            k_dma(slot, p + N_BUF).start()
-            v_dma(slot, p + N_BUF).start()
+            start_page(slot, p + N_BUF)
         return m_new, l_new, acc * alpha + pv
 
     D = q_ref.shape[2]
@@ -160,11 +196,17 @@ def paged_decode_attention_pallas(
     softcap: Optional[float] = None,
     interpret: bool = False,
     layer: Optional[jax.Array] = None,
+    k_scale: Optional[jax.Array] = None,   # [P, Hkv] / [Lg, P, Hkv] fp32
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     B, H, D = q.shape
+    quantized = k_scale is not None
     if layer is None:
         cache_k = cache_k[None]
         cache_v = cache_v[None]
+        if quantized:
+            k_scale = k_scale[None]
+            v_scale = v_scale[None]
         layer = jnp.zeros((), jnp.int32)
     Lg, P, ps, Hkv, _ = cache_k.shape
     # token-flat page view [Lg, P, ps*Hkv, D]: free reshape, and the
@@ -173,32 +215,56 @@ def paged_decode_attention_pallas(
     cv_flat = cache_v.reshape(Lg, P, ps * Hkv, D)
     q_scaled = q * scale
 
+    operands = [q_scaled, ck_flat, cv_flat]
+    cache_specs = [
+        pl.BlockSpec(memory_space=pltpu.ANY),
+        pl.BlockSpec(memory_space=pltpu.ANY),
+    ]
+    scratch = [
+        pltpu.VMEM((N_BUF, ps * Hkv, D), cache_k.dtype),
+        pltpu.VMEM((N_BUF, ps * Hkv, D), cache_v.dtype),
+        pltpu.SemaphoreType.DMA((N_BUF, 2)),
+    ]
+    if quantized:
+        # Pre-expand the per-page scales to per-COLUMN dequant rows
+        # [Lg, P, 1, ps*Hkv]: column t*Hkv+h' holds sigma[h'] (tile
+        # repeats the head axis ps times, matching the token-major
+        # column order), so one extra [1, ps*Hkv] row rides each page's
+        # DMA ring — ~3% of the page's int8 bytes.
+        ks_rows = jnp.tile(k_scale.astype(jnp.float32),
+                           (1, 1, ps)).reshape(Lg, P, 1, ps * Hkv)
+        vs_rows = jnp.tile(v_scale.astype(jnp.float32),
+                           (1, 1, ps)).reshape(Lg, P, 1, ps * Hkv)
+        operands += [ks_rows, vs_rows]
+        cache_specs += [
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ]
+        scratch += [
+            pltpu.VMEM((N_BUF, 1, ps * Hkv), jnp.float32),
+            pltpu.VMEM((N_BUF, 1, ps * Hkv), jnp.float32),
+            pltpu.SemaphoreType.DMA((N_BUF, 2)),
+        ]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
         grid=(B,),
-        in_specs=[
-            pl.BlockSpec((1, H, D), lambda b, *_: (b, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-        ],
+        in_specs=[pl.BlockSpec((1, H, D), lambda b, *_: (b, 0, 0))]
+        + cache_specs,
         out_specs=pl.BlockSpec((1, H, D), lambda b, *_: (b, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((N_BUF, ps * Hkv, D), cache_k.dtype),
-            pltpu.VMEM((N_BUF, ps * Hkv, D), cache_v.dtype),
-            pltpu.SemaphoreType.DMA((N_BUF, 2)),
-        ],
+        scratch_shapes=scratch,
     )
 
     kernel = functools.partial(_decode_kernel, page_size=ps, num_kv=Hkv,
-                               softcap=softcap)
+                               softcap=softcap, quantized=quantized)
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(page_tables, lengths, jnp.reshape(window, (1,)),
       jnp.reshape(layer, (1,)).astype(jnp.int32),
-      q_scaled, ck_flat, cv_flat)
+      *operands)
     return out

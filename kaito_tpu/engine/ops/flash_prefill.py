@@ -25,6 +25,11 @@ NEG_INF = -1e30
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 
+# jax renamed TPUCompilerParams -> CompilerParams; accept either so the
+# kernel loads against the pallas version this image ships
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
+
 
 def _flash_kernel(
     true_len_ref,      # [B] SMEM (scalar prefetch)
@@ -128,7 +133,7 @@ def flash_prefill_attention(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(true_len, jnp.reshape(window, (1,)), qt, kt, vt)

@@ -104,14 +104,24 @@ def plan_chunks(n_layers: int, n_pages: int, bytes_per_layer_page: int,
     return plans
 
 
-def serialize_chunk(k: np.ndarray, v: np.ndarray) -> bytes:
-    head = json.dumps({"shape": list(k.shape),
-                       "v_shape": list(v.shape),
-                       "dtype": str(k.dtype)}).encode()
-    return head + b"\n" + k.tobytes() + v.tobytes()
+def serialize_chunk(k: np.ndarray, v: np.ndarray,
+                    k_scale: Optional[np.ndarray] = None,
+                    v_scale: Optional[np.ndarray] = None) -> bytes:
+    head = {"shape": list(k.shape),
+            "v_shape": list(v.shape),
+            "dtype": str(k.dtype)}
+    body = k.tobytes() + v.tobytes()
+    if k_scale is not None:
+        # quantized KV: the fp32 page-scale slabs ride the same chunk
+        head["ks_shape"] = list(k_scale.shape)
+        head["vs_shape"] = list(v_scale.shape)
+        body += (np.ascontiguousarray(k_scale, np.float32).tobytes()
+                 + np.ascontiguousarray(v_scale, np.float32).tobytes())
+    return json.dumps(head).encode() + b"\n" + body
 
 
-def deserialize_chunk(payload: bytes) -> tuple[np.ndarray, np.ndarray]:
+def deserialize_chunk(payload: bytes) -> tuple[
+        np.ndarray, np.ndarray, Optional[np.ndarray], Optional[np.ndarray]]:
     head, _, body = payload.partition(b"\n")
     meta = json.loads(head)
     k_shape = tuple(meta["shape"])
@@ -121,12 +131,24 @@ def deserialize_chunk(payload: bytes) -> tuple[np.ndarray, np.ndarray]:
     dt = np.dtype(meta["dtype"])
     nk = int(np.prod(k_shape)) * dt.itemsize
     nv = int(np.prod(v_shape)) * dt.itemsize
-    if len(body) != nk + nv:
+    ks_shape = tuple(meta["ks_shape"]) if "ks_shape" in meta else None
+    vs_shape = tuple(meta["vs_shape"]) if "vs_shape" in meta else None
+    nks = int(np.prod(ks_shape)) * 4 if ks_shape is not None else 0
+    nvs = int(np.prod(vs_shape)) * 4 if vs_shape is not None else 0
+    if len(body) != nk + nv + nks + nvs:
         raise ValueError(f"chunk body is {len(body)} bytes, expected "
-                         f"{nk + nv} for K {k_shape} + V {v_shape} {dt}")
+                         f"{nk + nv + nks + nvs} for K {k_shape} + V "
+                         f"{v_shape} {dt}"
+                         + (f" + scales {ks_shape}/{vs_shape}"
+                            if ks_shape is not None else ""))
     k = np.frombuffer(body[:nk], dt).reshape(k_shape)
-    v = np.frombuffer(body[nk:], dt).reshape(v_shape)
-    return k, v
+    v = np.frombuffer(body[nk:nk + nv], dt).reshape(v_shape)
+    ks = vs = None
+    if ks_shape is not None:
+        off = nk + nv
+        ks = np.frombuffer(body[off:off + nks], np.float32).reshape(ks_shape)
+        vs = np.frombuffer(body[off + nks:], np.float32).reshape(vs_shape)
+    return k, v, ks, vs
 
 
 # ---------------------------------------------------------------------------
@@ -136,15 +158,20 @@ def deserialize_chunk(payload: bytes) -> tuple[np.ndarray, np.ndarray]:
 def _gather_canonical(cache: KVCache, pages: list[int]):
     """Device gather of a request's pages in the CANONICAL layer-major
     layout, from either a flat ([L, P, ...]) or pipeline-staged
-    ([S, L/S, P, ...]) pool."""
+    ([S, L/S, P, ...]) pool.  Returns ``(k, v, k_scale, v_scale)``;
+    the scales are None for non-quantized pools (and always for staged
+    pools — int8 KV is gated off under pipeline parallelism)."""
     idx = jnp.asarray(pages, jnp.int32)
     if cache.k.ndim == 6:                # stage-split pool
         S, Lps = cache.k.shape[0], cache.k.shape[1]
         return (cache.k[:, :, idx].reshape((S * Lps, len(pages))
                                            + cache.k.shape[3:]),
                 cache.v[:, :, idx].reshape((S * Lps, len(pages))
-                                           + cache.v.shape[3:]))
-    return cache.k[:, idx], cache.v[:, idx]
+                                           + cache.v.shape[3:]),
+                None, None)
+    ks = cache.k_scale[:, idx] if cache.k_scale is not None else None
+    vs = cache.v_scale[:, idx] if cache.v_scale is not None else None
+    return cache.k[:, idx], cache.v[:, idx], ks, vs
 
 
 def export_kv(cache: KVCache, pages: list[int]) -> tuple[dict, bytes]:
@@ -154,23 +181,30 @@ def export_kv(cache: KVCache, pages: list[int]) -> tuple[dict, bytes]:
     Returns (meta, payload).  The chunked path below supersedes this for
     serving; it remains the simple primitive for tests and in-process
     hand-off."""
-    k_dev, v_dev = _gather_canonical(cache, pages)
+    k_dev, v_dev, ks_dev, vs_dev = _gather_canonical(cache, pages)
     k = np.asarray(k_dev)                # [L, n, ps, Hkv, D]
     v = np.asarray(v_dev)
+    ks = np.asarray(ks_dev) if ks_dev is not None else None
+    vs = np.asarray(vs_dev) if vs_dev is not None else None
     meta = {"shape": list(k.shape), "v_shape": list(v.shape),
             "dtype": str(k.dtype)}
-    return meta, serialize_chunk(k, v)
+    if ks is not None:
+        meta["ks_shape"] = list(ks.shape)
+        meta["vs_shape"] = list(vs.shape)
+    return meta, serialize_chunk(k, v, ks, vs)
 
 
 def import_kv(cache: KVCache, pages: list[int], payload: bytes,
               meta: dict) -> KVCache:
     """Scatter a one-shot transfer into the local pool."""
-    k, v = deserialize_chunk(payload)
-    return import_arrays(cache, pages, k, v)
+    k, v, ks, vs = deserialize_chunk(payload)
+    return import_arrays(cache, pages, k, v, ks, vs)
 
 
 def import_arrays(cache: KVCache, pages: list[int], k: np.ndarray,
-                  v: np.ndarray) -> KVCache:
+                  v: np.ndarray,
+                  k_scale: Optional[np.ndarray] = None,
+                  v_scale: Optional[np.ndarray] = None) -> KVCache:
     """Scatter fully-assembled canonical [L, n_pages, ...] K/V into the
     pool in ONE device update (the single-copy cost a chunked receive
     pays at completion).
@@ -185,6 +219,16 @@ def import_arrays(cache: KVCache, pages: list[int], k: np.ndarray,
     expect = (L, len(pages)) + tuple(cache.k.shape[3 if staged else 2:])
     if tuple(k.shape) != expect:
         raise ValueError(f"KV shape mismatch: got {k.shape}, cache wants {expect}")
+    if (cache.k_scale is not None) != (k_scale is not None):
+        # never silently cast bf16 wire bytes into an int8 pool (or drop
+        # the scales of an int8 slab into a bf16 pool)
+        raise ValueError(
+            "KV quantization mismatch: "
+            + ("pool is int8 but the transfer carries no page scales"
+               if cache.k_scale is not None else
+               "transfer carries page scales but the pool is not int8")
+            + " — prefill and decode roles must run the same "
+              "--kv-cache-dtype")
     dt = cache.k.dtype
     idx = jnp.asarray(pages, jnp.int32)
     kj, vj = jnp.asarray(k, dt), jnp.asarray(v, dt)
@@ -197,8 +241,19 @@ def import_arrays(cache: KVCache, pages: list[int], k: np.ndarray,
                 kj.reshape((S, L // S) + k.shape[1:])),
             v=cache.v.at[:, :, idx].set(
                 vj.reshape((S, L // S) + v.shape[1:])))
+    new_ks, new_vs = cache.k_scale, cache.v_scale
+    if k_scale is not None:
+        expect_s = (L, len(pages), cache.k_scale.shape[-1])
+        if tuple(k_scale.shape) != expect_s:
+            raise ValueError(f"KV scale shape mismatch: got {k_scale.shape}, "
+                             f"cache wants {expect_s}")
+        new_ks = cache.k_scale.at[:, idx].set(
+            jnp.asarray(k_scale, jnp.float32))
+        new_vs = cache.v_scale.at[:, idx].set(
+            jnp.asarray(v_scale, jnp.float32))
     return KVCache(k=cache.k.at[:, idx].set(kj),
-                   v=cache.v.at[:, idx].set(vj))
+                   v=cache.v.at[:, idx].set(vj),
+                   k_scale=new_ks, v_scale=new_vs)
 
 
 def pack_transfer(meta: dict, payload: bytes) -> bytes:
@@ -226,13 +281,14 @@ class StagedExport:
 
     def __init__(self, k_dev, v_dev, meta: dict, plans: list[ChunkPlan],
                  prompt_tokens: list[int], first_token: int,
-                 lazy_drain: bool = False):
+                 lazy_drain: bool = False, ks_dev=None, vs_dev=None):
         self.meta = meta
         self.plans = plans
         self.prompt_tokens = prompt_tokens
         self.first_token = first_token
         self.created = time.monotonic()
         self._k_dev, self._v_dev = k_dev, v_dev
+        self._ks_dev, self._vs_dev = ks_dev, vs_dev
         self._chunks: list[Optional[bytes]] = [None] * len(plans)
         self._ready = [threading.Event() for _ in plans]
         self._error: Optional[str] = None
@@ -265,13 +321,16 @@ class StagedExport:
                          name="pd-export-copier").start()
 
     def device_slabs(self):
-        """The staged canonical device copies ``(k_dev, v_dev)`` for a
-        colocated device-to-device hand-off, or None once the drain has
-        released them.  The returned references stay valid even if the
-        drain finishes afterwards (the arrays are refcounted)."""
+        """The staged canonical device copies ``(k_dev, v_dev)`` — plus
+        ``(ks_dev, vs_dev)`` when the pool is quantized — for a colocated
+        device-to-device hand-off, or None once the drain has released
+        them.  The returned references stay valid even if the drain
+        finishes afterwards (the arrays are refcounted)."""
         with self._drain_lock:
             if self._k_dev is None:
                 return None
+            if self._ks_dev is not None:
+                return self._k_dev, self._v_dev, self._ks_dev, self._vs_dev
             return self._k_dev, self._v_dev
 
     def _drain(self):
@@ -282,7 +341,13 @@ class StagedExport:
                                            p.page_lo:p.page_hi])
                 v = np.asarray(self._v_dev[p.layer_lo:p.layer_hi,
                                            p.page_lo:p.page_hi])
-                self._chunks[i] = serialize_chunk(k, v)
+                ks = vs = None
+                if self._ks_dev is not None:
+                    ks = np.asarray(self._ks_dev[p.layer_lo:p.layer_hi,
+                                                 p.page_lo:p.page_hi])
+                    vs = np.asarray(self._vs_dev[p.layer_lo:p.layer_hi,
+                                                 p.page_lo:p.page_hi])
+                self._chunks[i] = serialize_chunk(k, v, ks, vs)
                 self._ready[i].set()
         except Exception as e:  # device wedge / shape bug: fail loudly
             self._error = f"{type(e).__name__}: {e}"
@@ -291,6 +356,7 @@ class StagedExport:
         finally:
             with self._drain_lock:
                 self._k_dev = self._v_dev = None   # unpin HBM
+                self._ks_dev = self._vs_dev = None
 
     @property
     def n_chunks(self) -> int:
@@ -358,11 +424,18 @@ class StagedExport:
                 dt = np.dtype(self.meta["dtype"])
                 k = np.empty(shape, dt)
                 v = np.empty(v_shape, dt)
+                ks = vs = None
+                if "ks_shape" in self.meta:
+                    ks = np.empty(tuple(self.meta["ks_shape"]), np.float32)
+                    vs = np.empty(tuple(self.meta["vs_shape"]), np.float32)
                 for i, p in enumerate(self.plans):
-                    ck, cv = deserialize_chunk(self.get_chunk(i))
+                    ck, cv, cks, cvs = deserialize_chunk(self.get_chunk(i))
                     k[p.layer_lo:p.layer_hi, p.page_lo:p.page_hi] = ck
                     v[p.layer_lo:p.layer_hi, p.page_lo:p.page_hi] = cv
-                self._blob = serialize_chunk(k, v)
+                    if ks is not None:
+                        ks[p.layer_lo:p.layer_hi, p.page_lo:p.page_hi] = cks
+                        vs[p.layer_lo:p.layer_hi, p.page_lo:p.page_hi] = cvs
+                self._blob = serialize_chunk(k, v, ks, vs)
             return self._blob
 
 
@@ -376,21 +449,28 @@ def stage_export(cache: KVCache, pages: list[int], *, n_tokens: int,
     A pipeline-staged pool ([S, L/S, P, ...]) gathers on the page axis
     and reshapes to the CANONICAL layer-major wire layout, so the
     receiving engine's parallelism doesn't have to match."""
-    k_dev, v_dev = _gather_canonical(cache, pages)
+    k_dev, v_dev, ks_dev, vs_dev = _gather_canonical(cache, pages)
     L, n_pages = int(k_dev.shape[0]), int(k_dev.shape[1])
     per_layer_page = int(np.prod(k_dev.shape[2:])
                          + np.prod(v_dev.shape[2:])) * k_dev.dtype.itemsize
+    if ks_dev is not None:
+        per_layer_page += int(np.prod(ks_dev.shape[2:])
+                              + np.prod(vs_dev.shape[2:])) * 4
     plans = plan_chunks(L, n_pages, per_layer_page)
     meta = {"shape": [int(s) for s in k_dev.shape],
             "v_shape": [int(s) for s in v_dev.shape],
             "dtype": str(k_dev.dtype), "n_tokens": n_tokens,
             "model": model, "chunks": [p.to_json() for p in plans]}
+    if ks_dev is not None:
+        meta["ks_shape"] = [int(s) for s in ks_dev.shape]
+        meta["vs_shape"] = [int(s) for s in vs_dev.shape]
     if trace_id:
         # trace identity rides the handoff meta so the decode role's
         # spans land under the SAME X-Request-Id (docs/observability.md)
         meta["trace_id"] = trace_id
     return StagedExport(k_dev, v_dev, meta, plans, prompt_tokens,
-                        first_token, lazy_drain=lazy_drain)
+                        first_token, lazy_drain=lazy_drain,
+                        ks_dev=ks_dev, vs_dev=vs_dev)
 
 
 class KVExportRegistry:
@@ -498,6 +578,10 @@ class ChunkedImport:
         dt = np.dtype(meta["dtype"])
         self._k_full = np.empty(shape, dt)
         self._v_full = np.empty(v_shape, dt)
+        self._ks_full = self._vs_full = None
+        if "ks_shape" in meta:
+            self._ks_full = np.empty(tuple(meta["ks_shape"]), np.float32)
+            self._vs_full = np.empty(tuple(meta["vs_shape"]), np.float32)
 
     @property
     def n_chunks(self) -> int:
@@ -546,7 +630,7 @@ class ChunkedImport:
             got, self._pending = self._pending[:max_n], self._pending[max_n:]
         for idx, payload in got:
             p = self.plans[idx]
-            k, v = deserialize_chunk(payload)
+            k, v, ks, vs = deserialize_chunk(payload)
             expect = (p.layer_hi - p.layer_lo,
                       p.page_hi - p.page_lo) + self._k_full.shape[2:]
             expect_v = (p.layer_hi - p.layer_lo,
@@ -555,8 +639,18 @@ class ChunkedImport:
                 raise ValueError(f"chunk {idx} shape mismatch: got "
                                  f"K {k.shape} V {v.shape}, plan wants "
                                  f"K {expect} V {expect_v}")
+            if (ks is not None) != (self._ks_full is not None):
+                raise ValueError(f"chunk {idx} quantization mismatch: "
+                                 f"chunk scales={'yes' if ks is not None else 'no'}, "
+                                 f"meta scales="
+                                 f"{'yes' if self._ks_full is not None else 'no'}")
             self._k_full[p.layer_lo:p.layer_hi, p.page_lo:p.page_hi] = k
             self._v_full[p.layer_lo:p.layer_hi, p.page_lo:p.page_hi] = v
+            if ks is not None:
+                self._ks_full[p.layer_lo:p.layer_hi,
+                              p.page_lo:p.page_hi] = ks
+                self._vs_full[p.layer_lo:p.layer_hi,
+                              p.page_lo:p.page_hi] = vs
             self.n_scattered += 1
         return len(got)
 
@@ -564,8 +658,12 @@ class ChunkedImport:
     def complete(self) -> bool:
         return self.n_scattered >= self.n_chunks
 
-    def full_arrays(self) -> tuple[np.ndarray, np.ndarray]:
+    def full_arrays(self) -> tuple:
+        """``(k, v)`` or ``(k, v, k_scale, v_scale)`` — star-unpack into
+        :func:`import_arrays`."""
         assert self.complete
+        if self._ks_full is not None:
+            return self._k_full, self._v_full, self._ks_full, self._vs_full
         return self._k_full, self._v_full
 
 
@@ -634,6 +732,7 @@ def estimate_params(arch) -> int:
 def transfer_cost(n_tokens: int, arch, dtype_bytes: int = 2, *,
                   net_bytes_s: float = 2.5e9, chip_flops: float = 1.97e14,
                   mfu: float = 0.35,
+                  scale_bytes_per_token: float = 0.0,
                   measured: Optional[TransferCostModel] = None) -> dict:
     """Estimate KV-transfer time vs local prefill recompute time.
 
@@ -643,9 +742,15 @@ def transfer_cost(n_tokens: int, arch, dtype_bytes: int = 2, *,
     engine has observed real transfers/prefills, the measured EWMA
     rates drive the decision (mid-range prompts on a fast link sit
     near the boundary, where a 4x prior error flips it the wrong
-    way)."""
+    way).
+
+    ``scale_bytes_per_token`` adds the fp32 page-scale overhead of an
+    int8 pool (8 * L * Hkv / page_size per token) so the break-even for
+    a quantized hand-off sees its true wire volume: ~half the bf16
+    bytes, which MOVES the boundary toward transferring."""
     kv_bytes = (2 * arch.num_layers * n_tokens * arch.num_kv_heads
                 * arch.head_dim * dtype_bytes)
+    kv_bytes = int(kv_bytes + scale_bytes_per_token * n_tokens)
     m = measured.snapshot() if measured is not None else {}
     net = m.get("net_bytes_s") or net_bytes_s
     transfer_s = kv_bytes / net
@@ -712,8 +817,7 @@ def bench_kv_handoff(model_name: str, ctxs, on_tpu: bool) -> dict:
                 ci.feed(i, staged.get_chunk(i))
             while not ci.complete:
                 ci.assemble(max_n=16)
-            k, v = ci.full_arrays()
-            dest = import_arrays(dest, pages, k, v)
+            dest = import_arrays(dest, pages, *ci.full_arrays())
             jax.block_until_ready((dest.k, dest.v))
             t_import = time.monotonic() - t1
         total_mb = staged.meta and (
@@ -735,8 +839,7 @@ def bench_kv_handoff(model_name: str, ctxs, on_tpu: bool) -> dict:
             staged_d = stage_export(cache, pages, n_tokens=ctx,
                                     model=model_name, prompt_tokens=[],
                                     first_token=0, lazy_drain=True)
-            k_dev, v_dev = staged_d.device_slabs()
-            dest2 = import_arrays(dest2, pages, k_dev, v_dev)
+            dest2 = import_arrays(dest2, pages, *staged_d.device_slabs())
             jax.block_until_ready((dest2.k, dest2.v))
             t_device = time.monotonic() - t2
         out[f"pd_device_handoff_ms@{ctx}"] = round(t_device * 1e3, 1)

@@ -523,6 +523,13 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                     src_eng.kv_exports.put(req_id, staged)
         cache = getattr(eng, "cache", None)
         kv_itemsize = cache.k.dtype.itemsize if cache is not None else 2
+        # an int8 pool transfers fp32 page scales alongside the codes:
+        # ~8*L*Hkv/page_size extra bytes per token on the wire
+        scale_bpt = 0.0
+        if cache is not None and getattr(cache, "k_scale", None) is not None:
+            arch = eng.md.arch
+            scale_bpt = (8.0 * arch.num_layers * arch.num_kv_heads
+                         / max(1, eng.cfg.page_size))
         # the recompute fallback re-samples the first token locally, so
         # it is only equivalence-preserving for greedy requests; sampled
         # requests always honor the prefill pod's first_token via the
@@ -530,6 +537,7 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         if (not kv_src.get("force") and params.temperature == 0.0
                 and not should_transfer(
                     len(prompt_tokens), eng.md.arch, kv_itemsize,
+                    scale_bytes_per_token=scale_bpt,
                     measured=getattr(eng, "pd_costs", None))):
             # below break-even: local prefill beats the wire.  Release
             # the staged export so the prefill pod doesn't hold it to
@@ -1125,6 +1133,13 @@ def main(argv=None):
                          "dispatch instead of serial chunks)")
     ap.add_argument("--served-model-name", default="")
     ap.add_argument("--dtype", default="")
+    ap.add_argument("--kv-cache-dtype", default=os.environ.get(
+        "KAITO_KV_CACHE_DTYPE", ""),
+        choices=["", "auto", "bfloat16", "float32", "int8"],
+        help="KV page-pool dtype (vLLM flag-name parity). 'int8' "
+             "quantizes K/V pages with per-page-per-head fp32 scales: "
+             "~2x KV capacity and half the HBM read per decode step. "
+             "Default/'auto' follows --dtype")
     ap.add_argument("--quantization", default=os.environ.get(
         "KAITO_QUANTIZATION", ""), choices=["", "int8"])
     ap.add_argument("--kaito-config-file", default="")
@@ -1193,7 +1208,9 @@ def main(argv=None):
         data_parallel=args.data_parallel_size,
         sequence_parallel=args.sequence_parallel_size,
         dtype=args.dtype or ("bfloat16" if on_tpu else "float32"),
-        kv_dtype=args.dtype or ("bfloat16" if on_tpu else "float32"),
+        kv_dtype=(args.kv_cache_dtype
+                  if args.kv_cache_dtype not in ("", "auto") else
+                  args.dtype or ("bfloat16" if on_tpu else "float32")),
         adapters_dir=args.kaito_adapters_dir,
         weights_dir=args.weights_dir,
         quantization=args.quantization,

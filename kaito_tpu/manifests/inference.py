@@ -64,6 +64,10 @@ def build_engine_command(
         "--port", str(PORT),
         "--max-model-len", str(plan.max_model_len),
     ]
+    kv_dtype = ws.metadata.annotations.get(
+        "kaito-tpu.io/kv-cache-dtype", "")
+    if kv_dtype:
+        args += ["--kv-cache-dtype", kv_dtype]
     if config_file:
         args += ["--kaito-config-file", config_file]
     if adapters_dir:

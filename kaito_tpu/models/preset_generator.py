@@ -41,6 +41,7 @@ def generate(hf_id: str, cfg: dict):
         "num_experts": a.num_experts,
         "param_count": a.param_count(),
         "kv_bytes_per_token_bf16": md.kv_bytes_per_token(2),
+        "kv_bytes_per_token_int8": md.kv_bytes_per_token(1),
         "model_file_bytes": md.file_bytes,
     }
     return md, out
@@ -53,6 +54,10 @@ def main(argv=None):
                     help="local recorded config.json (skips catalog/hub)")
     ap.add_argument("--chip", default="v5e",
                     help="TPU generation for the plan preview")
+    ap.add_argument("--kv-cache-dtype", default="bfloat16",
+                    choices=["bfloat16", "int8"],
+                    help="KV pool dtype assumed by the plan preview "
+                         "(int8 halves KV bytes/token)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output")
     args = ap.parse_args(argv)
@@ -74,7 +79,9 @@ def main(argv=None):
         from kaito_tpu.sku.catalog import CHIP_CATALOG
 
         chip = CHIP_CATALOG[args.chip]
-        plan = plan_parallelism(md, chip)
+        plan = plan_parallelism(
+            md, chip,
+            kv_dtype_bytes=1 if args.kv_cache_dtype == "int8" else 2)
         out["plan"] = {"chip": args.chip, "topology": plan.topology,
                        "num_slices": plan.num_slices,
                        "mesh": str(plan.mesh),

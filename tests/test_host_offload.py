@@ -128,3 +128,39 @@ def test_spill_restore_under_pp():
     assert eng.counters["preemptions_total"] >= 1
     assert eng.counters["host_kv_spilled_pages_total"] >= 1
     assert eng.counters["host_kv_restored_pages_total"] >= 1
+
+
+def test_spill_restore_int8_preserves_scales():
+    """int8-KV pool: a spill carries the page-scale rows to host and a
+    restore scatters them back — greedy output matches the spill-free
+    int8 run exactly (wrong scales would dequantize garbage)."""
+    base = dict(BASE, kv_dtype="int8")
+    solo = InferenceEngine(EngineConfig(**base))
+    solo.start()
+    try:
+        b_ref = list(solo.submit([50, 51, 52] * 11, _greedy(40)).stream())
+    finally:
+        solo.stop()
+
+    cfg = EngineConfig(**base, host_kv_offload_bytes=256 * 2**20)
+    eng, a_out, b_out = _run_pair(cfg)
+    assert len(a_out) == 100 and len(b_out) == 40
+    assert b_out == b_ref
+    assert eng.counters["preemptions_total"] >= 1
+    assert eng.counters["host_kv_restored_pages_total"] >= 1
+
+
+def test_host_pool_carries_scales():
+    import jax.numpy as jnp
+
+    from kaito_tpu.engine.host_offload import HostKVPool
+
+    k = jnp.ones((2, 3, 1, 4, 2), jnp.int8)
+    v = k * 2
+    ks = jnp.full((2, 3, 1), 0.5, jnp.float32)
+    vs = jnp.full((2, 3, 1), 0.25, jnp.float32)
+    pool = HostKVPool(max_bytes=1 << 20)
+    assert pool.put("q", k, v, written=5, k_scale=ks, v_scale=vs)
+    entry = pool.pop("q")
+    np.testing.assert_array_equal(np.asarray(entry.k_scale), np.asarray(ks))
+    np.testing.assert_array_equal(np.asarray(entry.v_scale), np.asarray(vs))

@@ -150,7 +150,7 @@ def test_pd_chunked_token_parity():
 
         from kaito_tpu.engine.pd import deserialize_chunk, serialize_chunk
 
-        whole_k, whole_v = deserialize_chunk(staged.whole_blob())
+        whole_k, whole_v, _, _ = deserialize_chunk(staged.whole_blob())
         order = list(range(len(fine)))[::-1]
         for i in order:
             pl = fine[i]
@@ -359,7 +359,7 @@ def test_pd_mla_roundtrip():
 
     # legacy whole-blob path (server's /pd/kv/<id> wire)
     blob = staged.whole_blob()
-    wk, wv = deserialize_chunk(blob)
+    wk, wv, _, _ = deserialize_chunk(blob)
     np.testing.assert_array_equal(wk, np.asarray(cache.k[:, pages]))
     assert wv.shape[-1] == 0
 
@@ -393,5 +393,86 @@ def test_pd_chunked_transfer_stall_fails_request():
                                                   temperature=0.0,
                                                   ignore_eos=True))
         assert len(list(ok.stream())) == 4
+    finally:
+        eng.stop()
+
+
+def test_pd_int8_chunked_handoff_matches_monolithic():
+    """int8-KV engines hand off quantized pages + fp32 page scales over
+    the chunked wire; the decode-role continuation matches a monolithic
+    int8 engine exactly.  Chunks arrive out of order and each carries
+    its own scale slab slice."""
+    import numpy as np
+
+    from kaito_tpu.engine.pd import (ChunkPlan, deserialize_chunk,
+                                     serialize_chunk)
+
+    cfg = dict(CFG, kv_dtype="int8")
+
+    def mk():
+        return InferenceEngine(EngineConfig(**cfg))
+
+    prompt = list(range(2, 40))
+    p = SamplingParams(max_tokens=16, temperature=0.0, ignore_eos=True)
+    ref = mk()
+    ref.start()
+    ref_out = list(ref.submit(prompt, p).stream())
+    ref.stop()
+
+    prod = mk()
+    prod.start()
+    pre = prod.submit(prompt, SamplingParams(max_tokens=1, temperature=0.0,
+                                             ignore_eos=True),
+                      export_kv=True)
+    first = list(pre.stream())[0]
+    staged = prod.kv_exports.pop(pre.req_id)
+    staged.wait_all()
+    assert "ks_shape" in staged.meta        # the wire header flags int8
+    fine = []
+    for pl in staged.plans:
+        for layer in range(pl.layer_lo, pl.layer_hi):
+            fine.append(ChunkPlan(layer, layer + 1, pl.page_lo, pl.page_hi))
+    assert len(fine) > 1
+
+    whole_k, whole_v, whole_ks, whole_vs = deserialize_chunk(
+        staged.whole_blob())
+    assert whole_k.dtype == np.int8 and whole_ks is not None
+
+    cons = mk()
+    cons.start()
+    try:
+        meta = dict(staged.meta)
+        meta["chunks"] = [pl.to_json() for pl in fine]
+        req = cons.submit_with_kv_chunked(prompt, first, meta, fine, p)
+        for i in list(range(len(fine)))[::-1]:
+            pl = fine[i]
+            sl = np.s_[pl.layer_lo:pl.layer_hi, pl.page_lo:pl.page_hi]
+            req.kv_chunked.feed(i, serialize_chunk(
+                np.ascontiguousarray(whole_k[sl]),
+                np.ascontiguousarray(whole_v[sl]),
+                np.ascontiguousarray(whole_ks[sl]),
+                np.ascontiguousarray(whole_vs[sl])))
+            cons._wake.set()
+        list(req.stream())
+        assert req.finish_reason != "error"
+        assert list(req.output_tokens) == ref_out
+    finally:
+        cons.stop()
+        prod.stop()
+
+
+def test_pd_rejects_kv_dtype_mismatch():
+    """A bf16-wire slab must not land in an int8 pool (or vice versa):
+    the request-thread validator rejects on the header dtype before any
+    scatter runs."""
+    eng = InferenceEngine(EngineConfig(**dict(CFG, kv_dtype="int8")))
+    eng.start()
+    try:
+        with pytest.raises(ValueError, match="kv-cache-dtype"):
+            eng._validate_kv_meta({"model": "tiny-llama-test",
+                                   "dtype": "float32"}, 4)
+        # matching wire dtype passes the same gate
+        eng._validate_kv_meta({"model": "tiny-llama-test",
+                               "dtype": "int8"}, 4)
     finally:
         eng.stop()

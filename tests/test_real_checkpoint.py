@@ -36,10 +36,10 @@ pytestmark = pytest.mark.skipif(not MODELS,
                                 reason="no committed checkpoints")
 
 
-def _engine(model, quant=""):
+def _engine(model, quant="", kv_dtype="float32"):
     cfg = EngineConfig(model=model,
                        weights_dir=os.path.join(REPO, "checkpoints", model),
-                       dtype="float32", kv_dtype="float32",
+                       dtype="float32", kv_dtype=kv_dtype,
                        max_model_len=512, max_num_seqs=2,
                        prefill_buckets=(64, 128),
                        enable_prefix_caching=False,
@@ -107,6 +107,36 @@ def test_int8_matches_its_golden(ckpt):
                 SamplingParams(max_tokens=len(p["int8"]["greedy_tokens"]),
                                temperature=0.0, ignore_eos=True))
             assert list(req.stream()) == p["int8"]["greedy_tokens"], p["text"]
+    finally:
+        eng.stop()
+
+
+def test_kv_int8_matches_its_golden(ckpt):
+    """int8 KV-cache serving of the real checkpoint pins to its own
+    golden.  Per-page-per-head quantization error is tiny but can flip
+    a near-tie (MoE router margins especially), so like weight-int8 the
+    mode pins to the continuation IT produced at golden time, plus a
+    loose logprob band against fp32 to bound the quantization error."""
+    model, golden, _ = ckpt
+    eng = _engine(model, kv_dtype="int8")
+    try:
+        for p in golden["prompts"]:
+            want = p["kv_int8"]["greedy_tokens"]
+            req = eng.submit(
+                list(p["prompt_tokens"]),
+                SamplingParams(max_tokens=len(want), temperature=0.0,
+                               ignore_eos=True, logprobs=True))
+            assert list(req.stream()) == want, p["text"]
+            got = [float(x) for x in req.output_logprobs]
+            np.testing.assert_allclose(
+                got, p["kv_int8"]["logprobs"], rtol=0, atol=2e-3,
+                err_msg=p["text"])
+            # when greedy agrees with fp32, the logprobs must sit close
+            # to the full-precision ones — the documented error bound
+            if want == p["fp32"]["greedy_tokens"]:
+                np.testing.assert_allclose(
+                    got, p["fp32"]["logprobs"], rtol=0, atol=0.1,
+                    err_msg=f"kv_int8 drift vs fp32: {p['text']}")
     finally:
         eng.stop()
 
