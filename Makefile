@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: all native unit-test unit-test-fast unit-test-slow engine-test rag-test chaos kvq obs bench serve manager clean
+.PHONY: all native unit-test unit-test-fast unit-test-slow engine-test rag-test chaos kvq obs slo bench serve manager clean
 
 all: native
 
@@ -42,11 +42,16 @@ kvq:
 	$(PYTHON) -m pytest tests/test_real_checkpoint.py -q -k "kv_int8"
 
 # observability suite (docs/observability.md): tracing, flight
-# recorder, router metrics, exposition-format invariants — fast tier
-# only (the slow e2e legs run under unit-test / unit-test-slow)
+# recorder, router metrics, exposition-format invariants, control-plane
+# metrics/Events, and the SLO watchdog — fast tier only (the slow e2e
+# legs run under unit-test / unit-test-slow)
 obs:
 	$(PYTHON) -m pytest tests/test_tracing.py tests/test_metrics_format.py \
-	  -q -m "not slow"
+	  tests/test_slo.py tests/test_controllers.py -q -m "not slow"
+
+# SLO watchdog suite alone (docs/observability.md "Control plane")
+slo:
+	$(PYTHON) -m pytest tests/test_slo.py -q
 
 bench:
 	$(PYTHON) bench.py

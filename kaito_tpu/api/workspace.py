@@ -26,6 +26,8 @@ COND_INFERENCE_READY = "InferenceReady"
 COND_TUNING_STARTED = "TuningJobStarted"
 COND_WORKSPACE_SUCCEEDED = "WorkspaceSucceeded"
 COND_BENCHMARK_COMPLETE = "BenchmarkComplete"
+# folded from the benchmark probe's /debug/slo verdict (runtime/slo.py)
+COND_SLO_HEALTHY = "SLOHealthy"
 
 # annotations / labels (our namespace, same roles as kaito.sh/*)
 ANNOTATION_DISABLE_BENCHMARK = "kaito-tpu.io/disable-benchmark"

@@ -19,6 +19,7 @@ from kaito_tpu.api.workspace import (
     LABEL_CREATED_BY_INFERENCESET,
 )
 from kaito_tpu.controllers.runtime import Store, update_with_retry
+from kaito_tpu.k8s.events import record_event
 
 
 def cron_matches(cron: str, at: datetime) -> bool:
@@ -146,5 +147,13 @@ class AutoUpgradeRunner:
                     update_with_retry(self.store, "Workspace",
                                       c.metadata.namespace, c.metadata.name,
                                       annotate)
+                    record_event(self.store, iset, "Normal",
+                                 "UpgradeWindowFired",
+                                 f"maintenance window open; upgrading "
+                                 f"{c.metadata.name} to "
+                                 f"{self.target_version}")
+                    record_event(self.store, c, "Normal", "UpgradeStarted",
+                                 f"auto-upgrade to {self.target_version} "
+                                 f"triggered by maintenance window")
                     return c.metadata.name
         return None

@@ -15,6 +15,7 @@ from kaito_tpu.api.workspace import (
     LABEL_CREATED_BY_INFERENCESET,
 )
 from kaito_tpu.controllers.runtime import Reconciler, Result, Store
+from kaito_tpu.k8s.events import record_event
 from kaito_tpu.provision.karpenter import LABEL_OWNER
 from kaito_tpu.provision.provisioner import ProvisionRequest
 from kaito_tpu.sku.catalog import CHIP_CATALOG, TPUSliceSpec
@@ -65,6 +66,9 @@ class DriftReconciler(Reconciler):
                     and self._has_ready_sibling(ws):
                 self.provisioner.set_drift_budget(req, True)
                 opened = True
+                record_event(self.store, ws, "Warning", "DriftDetected",
+                             "drifted node detected; disruption budget "
+                             "opened for rolling replacement")
             else:
                 self.provisioner.set_drift_budget(req, False)
         return Result(requeue_after=30.0 if drifted else 0.0)

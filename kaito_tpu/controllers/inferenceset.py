@@ -28,6 +28,7 @@ from kaito_tpu.controllers.runtime import (
     update_with_retry,
 )
 from kaito_tpu.controllers.workspace import BENCH_METRIC_PEAK_TPM
+from kaito_tpu.k8s.events import record_event
 
 logger = logging.getLogger(__name__)
 
@@ -119,6 +120,10 @@ class InferenceSetReconciler(Reconciler):
                 self.expectations.expect_creations(key, 1)
                 self.store.create(child)
                 creating += 1
+            if creating:
+                record_event(self.store, iset, "Normal", "ScalingUp",
+                             f"created {creating} replica workspace(s) "
+                             f"toward {want}")
         elif len(children) > want:
             # delete not-ready first (reference: :222-247)
             def readiness(ws):
@@ -129,6 +134,10 @@ class InferenceSetReconciler(Reconciler):
                 self.expectations.expect_deletions(key, 1)
                 self.store.delete("Workspace", v.metadata.namespace,
                                   v.metadata.name)
+            if victims:
+                record_event(self.store, iset, "Normal", "ScalingDown",
+                             f"deleted {len(victims)} replica workspace(s) "
+                             f"toward {want}")
 
         children = self._children(iset)
         ready = [c for c in children
@@ -148,6 +157,10 @@ class InferenceSetReconciler(Reconciler):
                 message=f"{len(ready)}/{want} replicas ready"))
         update_with_retry(self.store, "InferenceSet", iset.metadata.namespace,
                           iset.metadata.name, set_status)
+        was_ready = condition_true(iset.status.conditions, COND_SET_READY)
+        if len(ready) >= want and not was_ready:
+            record_event(self.store, iset, "Normal", "RolloutComplete",
+                         f"{len(ready)}/{want} replicas ready")
 
         if self.gateway_api_enabled:
             self._ensure_inference_pool(iset)

@@ -16,6 +16,7 @@ from typing import Optional
 
 from kaito_tpu.controllers.drift import DriftReconciler
 from kaito_tpu.controllers.inferenceset import InferenceSetReconciler
+from kaito_tpu.controllers.metrics import ManagerMetrics, start_manager_server
 from kaito_tpu.controllers.modelmirror import ModelMirrorReconciler
 from kaito_tpu.controllers.multiroleinference import MultiRoleInferenceReconciler
 from kaito_tpu.controllers.autoupgrade import AutoUpgradeRunner
@@ -32,8 +33,16 @@ class Manager:
     def __init__(self, store: Optional[Store] = None,
                  node_provisioner: str = "karpenter",
                  feature_gates: str = "",
-                 base_image_version: str = "latest"):
+                 base_image_version: str = "latest",
+                 metrics: Optional[ManagerMetrics] = None):
         self.store = store or Store()
+        self.metrics = metrics or ManagerMetrics()
+        events = getattr(self.store, "events", None)
+        if events is not None:
+            self.metrics.attach_event_counter(events)
+        if hasattr(self.store, "on_watch_restart"):
+            self.store.on_watch_restart = \
+                lambda kind: self.metrics.watch_restarts.inc(kind=kind)
         self.gates = parse_feature_gates(feature_gates)
         self.provisioner = new_node_provisioner(
             "byo" if self.gates["disableNodeAutoProvisioning"] else node_provisioner,
@@ -62,18 +71,47 @@ class Manager:
 
         self._stop = threading.Event()
 
+    def _reconcile_one(self, rec, obj) -> None:
+        """One instrumented reconcile: counted, timed, and recorded as
+        a span (trace id = object key, so ``/debug/trace?trace_id=
+        Workspace/ns/name`` shows one CR's reconcile history)."""
+        controller = type(rec).__name__
+        trace_id = f"{rec.kind}/{obj.metadata.namespace}/{obj.metadata.name}"
+        result = "ok"
+        t0 = time.monotonic()
+        try:
+            with self.metrics.tracer.span(f"reconcile.{rec.kind}", trace_id,
+                                          controller=controller):
+                res = rec.reconcile(obj)
+            if res is not None and (res.requeue or res.requeue_after > 0):
+                result = "requeue"
+        except Exception:
+            result = "error"
+            logger.exception("reconcile %s/%s failed", rec.kind,
+                             obj.metadata.name)
+        self.metrics.observe_reconcile(controller, result,
+                                       time.monotonic() - t0)
+
     def resync(self) -> None:
         """One full reconcile pass over every kind."""
+        self.metrics.resync_total.inc()
         for rec in self.reconcilers:
             for obj in self.store.list(rec.kind):
-                try:
-                    rec.reconcile(obj)
-                except Exception:
-                    logger.exception("reconcile %s/%s failed", rec.kind,
-                                     obj.metadata.name)
-        self.drift.reconcile_drift()
+                self._reconcile_one(rec, obj)
+        t0 = time.monotonic()
+        drift_result = "ok"
+        try:
+            with self.metrics.tracer.span("reconcile.Drift", "Drift/cluster",
+                                          controller="DriftReconciler"):
+                self.drift.reconcile_drift()
+        except Exception:
+            drift_result = "error"
+            logger.exception("drift pass failed")
+        self.metrics.observe_reconcile("DriftReconciler", drift_result,
+                                       time.monotonic() - t0)
         if self.autoupgrade:
             self.autoupgrade.tick()
+        self.metrics.refresh_conditions(self.store)
 
     def run(self, interval: float = 2.0) -> None:
         logger.info("manager running; gates=%s", self.gates)
@@ -106,6 +144,9 @@ def main(argv=None):
     ap.add_argument("--disable-preset-autogen", action="store_true",
                     help="do not auto-generate presets for unregistered "
                          "org/model ids (catalog + HF hub)")
+    ap.add_argument("--metrics-port", type=int, default=8080,
+                    help="manager /metrics + /debug/trace port (0 = off; "
+                         "matches the chart's metrics containerPort)")
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
@@ -133,6 +174,8 @@ def main(argv=None):
     mgr = Manager(store=store, node_provisioner=args.node_provisioner,
                   feature_gates=args.feature_gates,
                   base_image_version=args.base_image_version)
+    if args.metrics_port:
+        start_manager_server(mgr.metrics, port=args.metrics_port)
     if store is not None:
         # informer analogue: watch streams feed the expectations and
         # event-driven callbacks registered by the reconcilers

@@ -18,6 +18,7 @@ from kaito_tpu.api.modelmirror import (
 )
 from kaito_tpu.controllers.objects import Unstructured
 from kaito_tpu.controllers.runtime import Reconciler, Result, update_with_retry
+from kaito_tpu.k8s.events import record_event
 
 MIRROR_NAMESPACE = "kaito-tpu-system"
 
@@ -102,11 +103,23 @@ class ModelMirrorReconciler(Reconciler):
         self._set_phase(mirror, PHASE_DOWNLOADING, "downloading")
         return Result(requeue_after=10.0)
 
+    _PHASE_EVENTS = {
+        PHASE_DOWNLOADING: ("Normal", "DownloadStarted"),
+        PHASE_READY: ("Normal", "MirrorReady"),
+        PHASE_FAILED: ("Warning", "MirrorFailed"),
+    }
+
     def _set_phase(self, mirror, phase, message):
+        prev = {"phase": None}
+
         def mutate(o):
+            prev["phase"] = o.status.phase
             o.status.phase = phase
             set_condition(o.status.conditions, Condition(
                 type="Ready", status="True" if phase == PHASE_READY else "False",
                 reason=phase, message=message))
         update_with_retry(self.store, "ModelMirror", mirror.metadata.namespace,
                           mirror.metadata.name, mutate)
+        if prev["phase"] != phase and phase in self._PHASE_EVENTS:
+            etype, reason = self._PHASE_EVENTS[phase]
+            record_event(self.store, mirror, etype, reason, message)

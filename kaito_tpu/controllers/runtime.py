@@ -44,6 +44,13 @@ class Store:
         self._rv = 0
         self._watchers: list[Callable[[str, str, KaitoObject], None]] = []
         self._uid = 0
+        # in-memory Event sink (k8s/events.py): reconcilers record
+        # operator-visible transitions here; tests and the fake store
+        # read them back.  Imported lazily — k8s.store imports this
+        # module, so a top-level import would cycle.
+        from kaito_tpu.k8s.events import EventRecorder
+
+        self.events = EventRecorder()
 
     # -- CRUD ----------------------------------------------------------
 

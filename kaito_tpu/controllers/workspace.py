@@ -14,7 +14,7 @@ from __future__ import annotations
 import logging
 from typing import Optional
 
-from kaito_tpu.api.meta import Condition, ObjectMeta, set_condition
+from kaito_tpu.api.meta import Condition, ObjectMeta, get_condition, set_condition
 from kaito_tpu.api.modelmirror import (
     PHASE_READY,
     ModelMirror,
@@ -28,11 +28,13 @@ from kaito_tpu.api.workspace import (
     COND_INFERENCE_READY,
     COND_NODE_CLAIM_READY,
     COND_RESOURCE_READY,
+    COND_SLO_HEALTHY,
     COND_TUNING_STARTED,
     COND_WORKSPACE_SUCCEEDED,
     LABEL_WORKSPACE_NAME,
     Workspace,
 )
+from kaito_tpu.k8s.events import record_event
 from kaito_tpu.controllers.objects import Unstructured
 from kaito_tpu.controllers.runtime import (
     Reconciler,
@@ -79,8 +81,10 @@ class WorkspaceReconciler(Reconciler):
         ws.default()
         errs = ws.validate()
         if errs:
-            self._set_cond(ws, COND_RESOURCE_READY, "False",
-                           "ValidationFailed", "; ".join(errs))
+            if self._set_cond(ws, COND_RESOURCE_READY, "False",
+                              "ValidationFailed", "; ".join(errs)):
+                record_event(self.store, ws, "Warning", "ValidationFailed",
+                             "; ".join(errs))
             return Result()
 
         sync_controller_revision(self.store, ws, ws.revision_payload())
@@ -88,7 +92,9 @@ class WorkspaceReconciler(Reconciler):
         try:
             md, plan, slice_spec = self._plan(ws)
         except (KeyError, ValueError) as e:
-            self._set_cond(ws, COND_RESOURCE_READY, "False", "PlanFailed", str(e))
+            if self._set_cond(ws, COND_RESOURCE_READY, "False", "PlanFailed",
+                              str(e)):
+                record_event(self.store, ws, "Warning", "PlanFailed", str(e))
             return Result()
 
         # capacity
@@ -119,6 +125,9 @@ class WorkspaceReconciler(Reconciler):
             if repaired:
                 logger.info("repairing NotReady nodes for %s: %s",
                             ws.metadata.name, repaired)
+                record_event(self.store, ws, "Warning", "NodeRepaired",
+                             f"deleted NotReady nodes for replacement: "
+                             f"{', '.join(repaired)}")
         prov_s = (self.provisioner.provision_seconds(req)
                   if hasattr(self.provisioner, "provision_seconds") else None)
 
@@ -133,16 +142,22 @@ class WorkspaceReconciler(Reconciler):
                                ws.metadata.name, set_target)
 
         if not ready:
-            self._set_cond(ws, COND_NODE_CLAIM_READY, "False",
-                           snap_cond["reason"] if snap_cond else "Provisioning",
-                           snap_cond["message"] if snap_cond
-                           else f"{len(nodes)} nodes ready")
+            if self._set_cond(ws, COND_NODE_CLAIM_READY, "False",
+                              snap_cond["reason"] if snap_cond
+                              else "Provisioning",
+                              snap_cond["message"] if snap_cond
+                              else f"{len(nodes)} nodes ready"):
+                record_event(self.store, ws, "Normal", "ProvisioningStarted",
+                             f"waiting for TPU capacity "
+                             f"({len(nodes)} nodes ready)")
             return Result(requeue_after=5.0)
         ready_msg = f"{len(nodes)} nodes ready"
         if prov_s is not None:
             ready_msg += f" (provisioned in {prov_s:.1f}s)"
-        self._set_cond(ws, COND_NODE_CLAIM_READY, "True", "NodesReady",
-                       ready_msg)
+        if self._set_cond(ws, COND_NODE_CLAIM_READY, "True", "NodesReady",
+                          ready_msg):
+            record_event(self.store, ws, "Normal", "NodeClaimSatisfied",
+                         ready_msg)
         self._set_cond(ws, COND_RESOURCE_READY, "True", "ResourceReady", "")
 
         # weight cache gate (reference: ensureModelMirror :173 +
@@ -218,20 +233,31 @@ class WorkspaceReconciler(Reconciler):
         # image upgrade (reference: workspace_controller.go:676-685)
         upgrade_to = ws.metadata.annotations.get(ANNOTATION_UPGRADE_TO)
         if upgrade_to:
+            bumped = {"v": False}
+
             def bump(ss):
                 c = ss.spec["template"]["spec"]["containers"][0]
                 base = c["image"].rsplit(":", 1)[0]
+                bumped["v"] = c["image"] != f"{base}:{upgrade_to}"
                 c["image"] = f"{base}:{upgrade_to}"
             update_with_retry(self.store, "StatefulSet", ws.metadata.namespace,
                               ws.metadata.name, bump)
+            if bumped["v"]:
+                record_event(self.store, ws, "Normal", "UpgradeApplied",
+                             f"base image rolled to version {upgrade_to}")
 
         ss = self.store.try_get("StatefulSet", ws.metadata.namespace,
                                 ws.metadata.name)
         ready = bool(ss) and ss.status.get("readyReplicas", 0) >= ss.spec["replicas"]
-        self._set_cond(ws, COND_INFERENCE_READY, "True" if ready else "False",
-                       "InferenceReady" if ready else "PodsPending",
-                       f"{(ss.status.get('readyReplicas', 0) if ss else 0)}"
-                       f"/{plan.num_hosts} ready")
+        if self._set_cond(ws, COND_INFERENCE_READY,
+                          "True" if ready else "False",
+                          "InferenceReady" if ready else "PodsPending",
+                          f"{(ss.status.get('readyReplicas', 0) if ss else 0)}"
+                          f"/{plan.num_hosts} ready"):
+            record_event(self.store, ws, "Normal",
+                         "RolloutComplete" if ready else "RolloutStarted",
+                         f"{(ss.status.get('readyReplicas', 0) if ss else 0)}"
+                         f"/{plan.num_hosts} replicas ready")
 
         # benchmark result ingestion (reference: benchmark.go tails pod
         # logs for KAITO_BENCHMARK_RESULT; our probe posts to the SS
@@ -254,8 +280,10 @@ class WorkspaceReconciler(Reconciler):
                 # flip the condition, not crash the reconcile
                 failed, fail_msg = True, f"malformed benchmark result: {e}"
             if failed:
-                self._set_cond(ws, COND_BENCHMARK_COMPLETE, "False",
-                               "BenchmarkFailed", fail_msg)
+                if self._set_cond(ws, COND_BENCHMARK_COMPLETE, "False",
+                                  "BenchmarkFailed", fail_msg):
+                    record_event(self.store, ws, "Warning", "BenchmarkFailed",
+                                 fail_msg)
             else:
                 def record(o):
                     o.status.performance.metrics[BENCH_METRIC_PEAK_TPM] = \
@@ -266,8 +294,25 @@ class WorkspaceReconciler(Reconciler):
                 ws = update_with_retry(self.store, "Workspace",
                                        ws.metadata.namespace,
                                        ws.metadata.name, record)
-                self._set_cond(ws, COND_BENCHMARK_COMPLETE, "True",
-                               "BenchmarkComplete", "")
+                if self._set_cond(ws, COND_BENCHMARK_COMPLETE, "True",
+                                  "BenchmarkComplete", ""):
+                    record_event(
+                        self.store, ws, "Normal", "BenchmarkComplete",
+                        f"probe measured "
+                        f"{float(bench.get('total_tpm', 0.0)):.0f} tok/min")
+            # SLO verdict folding (runtime/slo.py): the probe ships the
+            # engine's /debug/slo snapshot inside the benchmark result;
+            # kubectl get workspace then shows the SLOHealthy condition
+            verdict = bench.get("slo")
+            if isinstance(verdict, dict):
+                from kaito_tpu.runtime.slo import condition_from_verdict
+
+                status, reason, message = condition_from_verdict(verdict)
+                if self._set_cond(ws, COND_SLO_HEALTHY, status, reason,
+                                  message):
+                    record_event(self.store, ws,
+                                 "Normal" if status == "True" else "Warning",
+                                 reason, message)
         if ready:
             self._set_cond(ws, COND_WORKSPACE_SUCCEEDED, "True", "Ready", "")
         return Result() if ready else Result(requeue_after=5.0)
@@ -325,13 +370,20 @@ class WorkspaceReconciler(Reconciler):
                               obj.metadata.name, mutate_svc)
 
     def _set_cond(self, ws: Workspace, type_: str, status: str, reason: str,
-                  message: str) -> None:
+                  message: str) -> bool:
+        """Upsert the condition; True when the STATUS transitioned
+        (the event-worthy edge — reason/message churn is not)."""
+        changed = {"v": False}
+
         def mutate(o):
+            prev = get_condition(o.status.conditions, type_)
+            changed["v"] = prev is None or prev.status != status
             set_condition(o.status.conditions, Condition(
                 type=type_, status=status, reason=reason, message=message,
                 observed_generation=o.metadata.generation))
         update_with_retry(self.store, "Workspace", ws.metadata.namespace,
                           ws.metadata.name, mutate)
+        return changed["v"]
 
     def _finalize(self, ws: Workspace) -> Result:
         try:

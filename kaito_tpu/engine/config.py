@@ -92,6 +92,12 @@ class EngineConfig:
     # log when its end-to-end latency crosses this (0 = off)
     trace_capacity: int = 8192           # span ring-buffer entries
     timeline_capacity: int = 4096        # step flight-recorder entries
+    # SLO watchdog targets (runtime/slo.py; defaults = BASELINE north
+    # star).  Env vars KAITO_SLO_* override these at server start.
+    slo_ttft_p50_ms: float = 200.0
+    slo_ttft_p99_ms: float = 1000.0
+    slo_tokens_per_sec_per_chip: float = 2000.0
+    slo_availability: float = 0.999
 
     def replace(self, **kw) -> "EngineConfig":
         return dataclasses.replace(self, **kw)

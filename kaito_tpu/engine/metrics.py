@@ -55,21 +55,61 @@ class Counter:
 
 
 class Gauge:
+    """Unlabelled (the original surface: ``.value`` / ``set(v)`` / a
+    scalar ``fn``) or labelled like Counter/Histogram.  A labelled
+    gauge stores one value per label set via ``set(v, **labels)``; a
+    labelled ``fn`` computes the whole family at scrape time and must
+    return a mapping of label-value tuples to floats (the router's
+    breaker state and the SLO burn rates are time-derived, so they
+    can't be stored)."""
+
     def __init__(self, name: str, help_: str, registry: "Optional[Registry]",
-                 fn=None):
+                 fn=None, labels: tuple[str, ...] = ()):
         self.name, self.help = name, help_
         self.fn = fn
+        self.label_names = labels
         self.value = 0.0
+        self._values: dict[tuple, float] = {}
+        self._lock = threading.Lock()
         if registry is not None:
             registry.register(self)
 
-    def set(self, v: float):
-        self.value = float(v)
+    def set(self, v: float, **labels):
+        if self.label_names:
+            key = tuple(str(labels.get(l, "")) for l in self.label_names)
+            with self._lock:
+                self._values[key] = float(v)
+        else:
+            self.value = float(v)
+
+    def labelled_value(self, **labels) -> float:
+        key = tuple(str(labels.get(l, "")) for l in self.label_names)
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def clear(self) -> None:
+        """Drop every stored series (per-CR gauges are rebuilt from a
+        full listing each resync, so deleted objects must not linger)."""
+        with self._lock:
+            self._values.clear()
 
     def collect(self) -> Iterable[str]:
-        v = self.fn() if self.fn is not None else self.value
         yield f"# HELP {self.name} {self.help}"
         yield f"# TYPE {self.name} gauge"
+        if self.label_names:
+            if self.fn is not None:
+                computed = self.fn() or {}
+                items = sorted(
+                    (tuple(str(x) for x in k), v)
+                    for k, v in computed.items())
+            else:
+                with self._lock:
+                    items = sorted(self._values.items())
+            for key, v in items:
+                yield (f"{self.name}"
+                       f"{_fmt_labels(self.label_names, key)} {_fmt(v)}")
+            return
+        v = self.fn() if self.fn is not None else self.value
         yield f"{self.name} {_fmt(v)}"
 
 

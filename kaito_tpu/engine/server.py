@@ -26,6 +26,8 @@ from kaito_tpu.engine.config import EngineConfig
 from kaito_tpu.engine.engine import InferenceEngine, SamplingParams
 from kaito_tpu.engine.metrics import EngineMetrics
 from kaito_tpu.engine.rate_limit import RateLimiter
+from kaito_tpu.runtime.slo import (SLOTargets, SLOWatchdog,
+                                   engine_chip_count)
 from kaito_tpu.utils.tracing import (chrome_trace, make_request_id,
                                      parse_traceparent, sanitize_request_id,
                                      timeline_trace)
@@ -34,6 +36,24 @@ logger = logging.getLogger(__name__)
 
 # one profiler per process (jax.profiler is process-global)
 _PROFILE_LOCK = threading.Lock()
+
+
+def _profile_auto_stop(st) -> None:
+    """Timer target for /start_profile {"seconds": N}: stop the trace
+    unless a manual /stop_profile already did."""
+    import jax
+
+    with _PROFILE_LOCK:
+        if not getattr(st, "_profiling", False):
+            return
+        try:
+            jax.profiler.stop_trace()
+            logger.info("profiler trace auto-stopped")
+        except Exception:
+            logger.exception("profiler auto-stop failed")
+        finally:
+            st._profiling = False
+            st._profile_timer = None
 
 
 from kaito_tpu.engine.adapters import discover_adapters  # noqa: E402
@@ -67,6 +87,17 @@ class ServerState:
         self.model_name = cfg.served_model_name or engine.md.name
         self.adapters = discover_adapters(cfg.adapters_dir)
         self.started = time.time()
+        # north-star SLO watchdog: config targets, env override on top
+        # (KAITO_SLO_* wins so operators can retune without a rollout)
+        self.slo = SLOWatchdog(
+            targets=SLOTargets.from_env(SLOTargets(
+                ttft_p50_s=cfg.slo_ttft_p50_ms / 1000.0,
+                ttft_p99_s=cfg.slo_ttft_p99_ms / 1000.0,
+                tokens_per_sec_per_chip=cfg.slo_tokens_per_sec_per_chip,
+                availability=cfg.slo_availability)),
+            chips=engine_chip_count(engine))
+        self.slo.register_metrics(self.metrics.registry)
+        self._profile_timer: Optional[threading.Timer] = None
 
 
 class OpenAIHandler(BaseHTTPRequestHandler):
@@ -194,6 +225,8 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             self._debug_trace()
         elif self.path.startswith("/debug/timeline"):
             self._debug_timeline()
+        elif self.path.startswith("/debug/slo"):
+            self._json(200, st.slo.snapshot())
         else:
             self._error(404, f"no route {self.path}")
 
@@ -264,11 +297,19 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         import jax
 
         st = self.state
-        # drain the body (clients POST JSON here); an unread payload
-        # would desync the next request on a keep-alive connection
+        # the body is optional JSON; always consume it (an unread
+        # payload would desync the next request on keep-alive)
         n = int(self.headers.get("Content-Length", "0") or 0)
-        if n:
-            self.rfile.read(n)
+        raw = self.rfile.read(n) if n else b""
+        seconds = 0.0
+        if start and raw:
+            try:
+                seconds = float((json.loads(raw) or {}).get("seconds", 0))
+            except (ValueError, json.JSONDecodeError, AttributeError,
+                    TypeError):
+                return self._error(400, "invalid JSON body")
+            if seconds < 0:
+                return self._error(400, "'seconds' must be >= 0")
         prof_dir = os.environ.get("KAITO_PROFILE_DIR", "/tmp/kaito-profile")
         with _PROFILE_LOCK:
             active = getattr(st, "_profiling", False)
@@ -278,11 +319,28 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                         return self._error(409, "profiler already running")
                     jax.profiler.start_trace(prof_dir)
                     st._profiling = True
-                    logger.info("profiler trace started -> %s", prof_dir)
-                    return self._json(200, {"status": "started",
-                                            "dir": prof_dir})
+                    if seconds:
+                        # bounded capture: auto-stop after `seconds` so
+                        # a fire-and-forget client can't leave the
+                        # process-global profiler running forever
+                        timer = threading.Timer(
+                            seconds, _profile_auto_stop, args=(st,))
+                        timer.daemon = True
+                        st._profile_timer = timer
+                        timer.start()
+                    logger.info("profiler trace started -> %s%s", prof_dir,
+                                f" (auto-stop in {seconds:g}s)"
+                                if seconds else "")
+                    body = {"status": "started", "dir": prof_dir}
+                    if seconds:
+                        body["auto_stop_seconds"] = seconds
+                    return self._json(200, body)
                 if not active:
                     return self._error(409, "profiler not running")
+                timer = getattr(st, "_profile_timer", None)
+                if timer is not None:
+                    timer.cancel()
+                    st._profile_timer = None
                 jax.profiler.stop_trace()
                 st._profiling = False
                 logger.info("profiler trace stopped")
@@ -623,6 +681,7 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         if shed is not None:
             st.metrics.requests_rejected.inc()
             st.metrics.requests_shed.inc(reason=shed)
+            st.slo.note_shed()
             try:
                 # best-effort: the flight recorder reports shed pressure
                 # per step (the DP facade's computed counters drop this)
@@ -870,6 +929,7 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             self._sse_send(fin)
             self._sse_end()
             st.metrics.observe_request(req)
+            st.slo.observe_request(req)
             return
 
         choices = []
@@ -883,6 +943,7 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             bad = next(r for r in all_reqs
                        if r.finish_reason in ("error", "deadline"))
             st.metrics.observe_request(req)
+            st.slo.observe_request(bad)
             return self._request_error(bad)
         for idx, (r, out_ids) in enumerate(zip(all_reqs, outs)):
             total_completion += len(out_ids)
@@ -953,6 +1014,7 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         resp = dict(base)
         resp.update({"choices": choices, "usage": usage})
         st.metrics.observe_request(req)
+        st.slo.observe_request(req)
         self._json(200, resp)
 
 

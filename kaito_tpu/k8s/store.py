@@ -46,6 +46,15 @@ class KubeStore:
         self._watchers: list[Callable[[str, str, KaitoObject], None]] = []
         self._watch_stop = threading.Event()
         self._watch_threads: list[threading.Thread] = []
+        # Events recorded by reconcilers mirror to the API server as
+        # real v1.Event objects (and stay greppable in-memory too)
+        from kaito_tpu.k8s.events import EventRecorder, KubeEventSink
+
+        self.events = EventRecorder(
+            sink=KubeEventSink(self.client, namespace=namespace))
+        # manager metrics hook: called with the kind each time a watch
+        # stream ends and the loop reconnects
+        self.on_watch_restart: Optional[Callable[[str], None]] = None
 
     # -- CRUD ----------------------------------------------------------
 
@@ -186,6 +195,11 @@ class KubeStore:
 
             self.client.watch(path, handler, self._watch_stop,
                               resource_version=last_rv["rv"])
+            if not self._watch_stop.is_set() and self.on_watch_restart:
+                try:
+                    self.on_watch_restart(kind)
+                except Exception:
+                    logger.debug("watch-restart hook failed", exc_info=True)
             self._watch_stop.wait(1.0)
 
     def stop_watching(self) -> None:

@@ -28,6 +28,7 @@ from typing import Optional
 from kaito_tpu.api.meta import ObjectMeta
 from kaito_tpu.controllers.objects import Unstructured, is_node_ready
 from kaito_tpu.controllers.runtime import Store, update_with_retry
+from kaito_tpu.k8s.events import record_event
 from kaito_tpu.provision.provisioner import ProvisionRequest
 from kaito_tpu.sku.catalog import (
     LABEL_TPU_ACCELERATOR,
@@ -154,14 +155,21 @@ class KarpenterTPUProvisioner:
         for idx in range(req.num_slices):
             name = self._pool_name(req, idx)
             if self.store.try_get("NodePool", "", name) is None:
-                self.store.create(Unstructured(
+                pool = Unstructured(
                     "NodePool",
                     ObjectMeta(name=name, namespace="",
                                labels={LABEL_OWNER: req.owner_name},
                                annotations={
                                    ANNOTATION_PROVISION_START:
                                    f"{time.time():.3f}"}),
-                    spec=self.render_nodepool(req, idx)))
+                    spec=self.render_nodepool(req, idx))
+                self.store.create(pool)
+                record_event(self.store, pool, "Normal",
+                             "ProvisioningStarted",
+                             f"created NodePool {name} for "
+                             f"{req.owner_namespace}/{req.owner_name} "
+                             f"({req.slice_spec.num_hosts} host(s), "
+                             f"topology {req.slice_spec.topology})")
 
     def _byo_covered(self, req: ProvisionRequest) -> list[str]:
         """Ready preferredNodes with the right accelerator label AND
@@ -306,6 +314,10 @@ class KarpenterTPUProvisioner:
                     continue
                 self.store.delete("Node", "", n.metadata.name)
                 deleted.append(n.metadata.name)
+                record_event(self.store, n, "Warning", "NodeRepaired",
+                             f"deleted NotReady node {n.metadata.name} "
+                             f"after {now - float(since):.0f}s; pool will "
+                             f"replace it")
         return deleted
 
     def deprovision(self, req: ProvisionRequest) -> None:

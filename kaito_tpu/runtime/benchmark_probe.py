@@ -41,13 +41,19 @@ def _get(url: str, timeout: float = 10.0) -> str:
 
 
 def _metric(metrics_text: str, name: str) -> float:
+    """Sum every series of a family: a labelled family (e.g. a counter
+    split by reason, or a DP facade exporting per-group series) exposes
+    several lines, and reading only the first one under-counts."""
+    total = 0.0
+    seen = False
     for line in metrics_text.splitlines():
         if line.startswith(name + " ") or line.startswith(name + "{"):
             try:
-                return float(line.rsplit(" ", 1)[1])
+                total += float(line.rsplit(" ", 1)[1])
+                seen = True
             except ValueError:
                 pass
-    return 0.0
+    return total if seen else 0.0
 
 
 def wait_healthy(base: str, deadline_s: float) -> bool:
@@ -152,6 +158,16 @@ def run_benchmark(base: str, *, duration_s: float = BENCHMARK_DURATION_S,
             # engine's self-measured HBM sizing + estimator drift rides
             # into status.performance alongside the throughput numbers
             result["hbm_sizing"] = health["hbm_sizing"]
+    except Exception:
+        pass
+    try:
+        slo = json.loads(_get(base + "/debug/slo"))
+        if isinstance(slo, dict) and "alerts" in slo:
+            # SLO verdict rides along so the workspace controller can
+            # fold it into the SLOHealthy condition (runtime/slo.py)
+            result["slo"] = {k: slo.get(k) for k in
+                             ("healthy", "alerts", "burn_rates", "targets")}
+            result["slo"]["sli"] = (slo.get("sli") or {}).get("fast")
     except Exception:
         pass
     _emit("KAITO_BENCHMARK_RESULT", result, sink)

@@ -41,7 +41,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
-from kaito_tpu.engine.metrics import Counter, Histogram, Registry
+from kaito_tpu.engine.metrics import Counter, Gauge, Histogram, Registry
 from kaito_tpu.utils.failpoints import FAILPOINTS, FailpointError
 from kaito_tpu.utils.tracing import (make_request_id, parse_traceparent,
                                      sanitize_request_id)
@@ -116,22 +116,7 @@ class _Backend:
         self.down_until = 0.0
 
 
-class _BreakerStateCollector:
-    """Scrape-time breaker gauge: state is time-derived (``down_until``
-    vs now), so it must be computed at collect(), not stored."""
-
-    _STATES = {"closed": 0, "half-open": 1, "open": 2}
-
-    def __init__(self, router: "DPRouter"):
-        self.router = router
-
-    def collect(self):
-        yield ("# HELP kaito:router_backend_breaker_state Circuit "
-               "breaker per backend (0=closed, 1=half-open, 2=open)")
-        yield "# TYPE kaito:router_backend_breaker_state gauge"
-        for b in self.router.backends:
-            yield (f'kaito:router_backend_breaker_state'
-                   f'{{backend="{b.url}"}} {self._STATES[b.state]}')
+_BREAKER_STATES = {"closed": 0, "half-open": 1, "open": 2}
 
 
 class DPRouter:
@@ -167,7 +152,13 @@ class DPRouter:
             buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
                      0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0),
             labels=("backend",))
-        r.register(_BreakerStateCollector(self))
+        # breaker state is time-derived (down_until vs now), so the
+        # family is computed at scrape time via the labelled-fn Gauge
+        Gauge("kaito:router_backend_breaker_state",
+              "Circuit breaker per backend (0=closed, 1=half-open, 2=open)",
+              r, labels=("backend",),
+              fn=lambda: {(b.url,): _BREAKER_STATES[b.state]
+                          for b in self.backends})
 
     def next_backend(self) -> Optional[_Backend]:
         """Next live backend (round robin), or the next one regardless

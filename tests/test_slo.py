@@ -1,0 +1,329 @@
+"""SLO watchdog suite (docs/observability.md "Control plane"): window
+math and burn-rate transitions on a fake clock, condition folding,
+metric exposition, and the live engine's /debug/slo flip."""
+
+import json
+import threading
+import time
+import types
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kaito_tpu.engine.metrics import Registry
+from kaito_tpu.runtime.slo import (
+    STATE_OK,
+    STATE_PAGE,
+    STATE_WARN,
+    SLOTargets,
+    SLOWatchdog,
+    condition_from_verdict,
+    engine_chip_count,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+def _watchdog(**kw):
+    clock = FakeClock()
+    kw.setdefault("windows", (10.0, 100.0))
+    wd = SLOWatchdog(time_fn=clock, **kw)
+    return wd, clock
+
+
+# ---------------------------------------------------------------- windows
+
+
+def test_window_pruning_and_percentiles():
+    wd, clock = _watchdog()
+    for v in (0.05, 0.10, 0.15):
+        wd.observe_ttft(v)
+    fast = wd._eval_window(10.0)
+    assert fast["ttft_samples"] == 3
+    assert fast["ttft_p50_s"] == pytest.approx(0.10)
+    # samples age out of the fast window but stay in the slow one
+    clock.advance(50.0)
+    assert wd._eval_window(10.0)["ttft_samples"] == 0
+    assert wd._eval_window(100.0)["ttft_samples"] == 3
+    # ... and out of the slow window too
+    clock.advance(60.0)
+    assert wd._eval_window(100.0)["ttft_samples"] == 0
+
+
+def test_no_traffic_is_healthy():
+    wd, _ = _watchdog()
+    snap = wd.snapshot()
+    assert snap["healthy"]
+    assert all(a == STATE_OK for a in snap["alerts"].values())
+    assert snap["sli"]["fast"]["availability"] == 1.0
+
+
+def test_throughput_normalizes_per_chip_and_young_process():
+    wd, clock = _watchdog(chips=4)
+    clock.advance(2.0)          # process is 2s old, window is 10s
+    wd.note_tokens(800)
+    fast = wd._eval_window(10.0)
+    # 800 tokens / 2s elapsed / 4 chips — not diluted by the full window
+    assert fast["tokens_per_sec_per_chip"] == pytest.approx(100.0)
+
+
+# ---------------------------------------------------------------- burn
+
+
+def test_ttft_burn_ok_to_page():
+    wd, _ = _watchdog()         # default target: p50 < 200 ms
+    assert wd.snapshot()["alerts"]["ttft_p50"] == STATE_OK
+    # every request misses the bound -> bad fraction 1.0, budget 0.5,
+    # burn 2.0 on BOTH windows -> page
+    for _ in range(5):
+        wd.observe_ttft(0.5)
+        wd.success.add(1)
+    snap = wd.snapshot()
+    assert snap["burn_rates"]["ttft_p50"]["fast"] == pytest.approx(2.0)
+    assert snap["burn_rates"]["ttft_p50"]["slow"] == pytest.approx(2.0)
+    assert snap["alerts"]["ttft_p50"] == STATE_PAGE
+    assert not snap["healthy"]
+
+
+def test_fast_window_only_breach_is_warn():
+    wd, clock = _watchdog()
+    # a long healthy history in the slow window...
+    for _ in range(20):
+        wd.observe_ttft(0.01)
+    clock.advance(50.0)         # beyond fast (10s), inside slow (100s)
+    # ...then one bad sample: fast window burns, slow does not
+    wd.observe_ttft(0.5)
+    snap = wd.snapshot()
+    assert snap["burn_rates"]["ttft_p50"]["fast"] > 1.0
+    assert snap["burn_rates"]["ttft_p50"]["slow"] < 1.0
+    assert snap["alerts"]["ttft_p50"] == STATE_WARN
+    assert snap["healthy"]      # warn does not page
+
+
+def test_availability_counts_shed_and_failures():
+    wd, _ = _watchdog()
+    for _ in range(9):
+        wd.success.add(1)
+    wd.failure.add(1)
+    wd.note_shed()
+    snap = wd.snapshot()
+    fast = snap["sli"]["fast"]
+    assert fast["requests"] == 11
+    assert fast["availability"] == pytest.approx(9 / 11, abs=1e-4)
+    # bad fraction 2/11 against a 0.1% budget -> way past burning
+    assert snap["burn_rates"]["availability"]["fast"] > 100
+    assert snap["alerts"]["availability"] == STATE_PAGE
+
+
+def test_throughput_floor_alert():
+    wd, clock = _watchdog(chips=1)
+    clock.advance(10.0)
+    wd.note_tokens(50)          # 50 tok / 10 s = 5 tok/s/chip << 2000
+    snap = wd.snapshot()
+    assert snap["alerts"]["throughput"] == STATE_PAGE
+    # zero traffic must NOT alert (idle engine != slow engine)
+    wd2, _ = _watchdog()
+    assert wd2.snapshot()["alerts"]["throughput"] == STATE_OK
+
+
+# ---------------------------------------------------------------- targets
+
+
+def test_targets_from_env(monkeypatch):
+    monkeypatch.setenv("KAITO_SLO_TTFT_P50_MS", "350")
+    monkeypatch.setenv("KAITO_SLO_TOKENS_PER_SEC_PER_CHIP", "1500")
+    monkeypatch.setenv("KAITO_SLO_AVAILABILITY", "not-a-number")
+    base = SLOTargets(ttft_p99_s=2.0, availability=0.95)
+    t = SLOTargets.from_env(base)
+    assert t.ttft_p50_s == pytest.approx(0.350)
+    assert t.tokens_per_sec_per_chip == 1500.0
+    assert t.ttft_p99_s == 2.0          # not overridden
+    assert t.availability == 0.95       # bad value ignored
+
+
+def test_observe_request_reads_engine_request_shape():
+    wd, _ = _watchdog()
+    req = types.SimpleNamespace(
+        submit_time=1.0, first_token_time=1.05, finish_time=2.0,
+        output_tokens=[1, 2, 3], finish_reason="stop")
+    wd.observe_request(req)
+    bad = types.SimpleNamespace(
+        submit_time=1.0, first_token_time=None, finish_time=2.0,
+        output_tokens=[], finish_reason="error")
+    wd.observe_request(bad)
+    fast = wd._eval_window(10.0)
+    assert fast["ttft_samples"] == 1
+    assert fast["requests"] == 2
+    assert fast["availability"] == pytest.approx(0.5)
+
+
+# ---------------------------------------------------------------- folding
+
+
+def test_condition_from_verdict_healthy():
+    status, reason, _ = condition_from_verdict(
+        {"healthy": True, "alerts": {"ttft_p50": "ok"}})
+    assert (status, reason) == ("True", "SLOMet")
+
+
+def test_condition_from_verdict_page_is_false():
+    status, reason, message = condition_from_verdict(
+        {"healthy": False,
+         "alerts": {"ttft_p50": "page", "availability": "ok"}})
+    assert (status, reason) == ("False", "SLOBurnRate")
+    assert "ttft_p50" in message
+
+
+def test_condition_from_verdict_warn_stays_true():
+    status, reason, message = condition_from_verdict(
+        {"healthy": True, "alerts": {"ttft_p99": "warn"}})
+    assert (status, reason) == ("True", "SLOWarning")
+    assert "ttft_p99" in message
+
+
+def test_engine_chip_count():
+    mesh = types.SimpleNamespace(devices=types.SimpleNamespace(size=4))
+    e = types.SimpleNamespace(mesh=mesh)
+    assert engine_chip_count(e) == 4
+    dp = types.SimpleNamespace(engines=[e, e])
+    assert engine_chip_count(dp) == 8
+    meshless = types.SimpleNamespace(mesh=None)
+    assert engine_chip_count(meshless) == 1
+
+
+# ---------------------------------------------------------------- metrics
+
+
+def test_slo_metric_families_on_registry():
+    wd, _ = _watchdog()
+    r = Registry()
+    wd.register_metrics(r)
+    wd.observe_ttft(0.5)
+    wd.failure.add(1)
+    text = r.expose()
+    assert 'kaito:slo_burn_rate{sli="ttft_p50",window="5m"} 2' in text
+    assert 'kaito:slo_burn_rate{sli="ttft_p50",window="1h"} 2' in text
+    assert 'kaito:slo_alert_state{sli="ttft_p50"} 2' in text
+    assert "kaito:slo_ttft_p50_seconds 0.5" in text
+    assert "kaito:slo_healthy 0" in text
+    assert "kaito:slo_tokens_per_sec_per_chip" in text
+    assert "kaito:slo_availability" in text
+
+
+# ---------------------------------------------------------------- live
+
+
+@pytest.fixture(scope="module")
+def served():
+    from kaito_tpu.engine.config import EngineConfig
+    from kaito_tpu.engine.engine import InferenceEngine
+    from kaito_tpu.engine.server import make_server
+
+    cfg = EngineConfig(model="tiny-llama-test", max_model_len=512,
+                       page_size=16, max_num_seqs=4, dtype="float32",
+                       kv_dtype="float32", prefill_buckets=(128, 256))
+    engine = InferenceEngine(cfg)
+    engine.start()
+    server = make_server(engine, cfg, host="127.0.0.1", port=0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{port}", server.state
+    server.shutdown()
+    engine.stop()
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def test_live_debug_slo_flips_on_ttft_breach(served):
+    base, state = served
+    snap = _get_json(base + "/debug/slo")
+    assert snap["healthy"]
+    assert snap["alerts"]["ttft_p50"] == STATE_OK
+    assert snap["targets"]["ttft_p50_ms"] == pytest.approx(200.0)
+
+    # no request can beat a nanosecond TTFT target: the very next
+    # observation burns both windows -> page
+    state.slo.targets.ttft_p50_s = 1e-9
+    state.slo.targets.ttft_p99_s = 1e-9
+    body = json.dumps({"prompt": "hello slo", "max_tokens": 4,
+                       "temperature": 0.0}).encode()
+    req = urllib.request.Request(
+        base + "/v1/completions", data=body,
+        headers={"Content-Type": "application/json"})
+    out = json.loads(urllib.request.urlopen(req, timeout=60).read())
+    assert out["usage"]["completion_tokens"] > 0
+
+    snap = _get_json(base + "/debug/slo")
+    assert snap["sli"]["fast"]["ttft_samples"] >= 1
+    assert snap["burn_rates"]["ttft_p50"]["fast"] > 1.0
+    assert snap["alerts"]["ttft_p50"] == STATE_PAGE
+    assert not snap["healthy"]
+
+    # the same verdict folds to a False SLOHealthy condition
+    status, reason, _ = condition_from_verdict(snap)
+    assert (status, reason) == ("False", "SLOBurnRate")
+
+
+def test_live_metrics_exposes_slo_gauges(served):
+    base, _ = served
+    with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+        text = r.read().decode()
+    assert "kaito:slo_burn_rate{" in text
+    assert 'kaito:slo_alert_state{sli="availability"}' in text
+    assert "kaito:slo_healthy" in text
+
+
+def test_live_probe_folds_slo_into_result(served, tmp_path):
+    from kaito_tpu.runtime.benchmark_probe import run_benchmark
+
+    base, _ = served
+    sink = tmp_path / "probe.log"
+    result = run_benchmark(base, duration_s=2, input_len=32, output_len=8,
+                           concurrency=2, sink=str(sink))
+    assert "slo" in result
+    assert set(result["slo"]["alerts"]) >= {"ttft_p50", "availability",
+                                            "throughput"}
+    assert "healthy" in result["slo"]
+
+
+def test_live_profile_auto_stop(served):
+    base, state = served
+    body = json.dumps({"seconds": 0.3}).encode()
+    req = urllib.request.Request(
+        base + "/start_profile", data=body,
+        headers={"Content-Type": "application/json"})
+    out = json.loads(urllib.request.urlopen(req, timeout=30).read())
+    assert out["status"] == "started"
+    assert out["auto_stop_seconds"] == pytest.approx(0.3)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and getattr(state, "_profiling", False):
+        time.sleep(0.05)
+    assert not state._profiling
+    # the trace already stopped: a manual stop must 409, not crash
+    req = urllib.request.Request(base + "/stop_profile", data=b"{}")
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        urllib.request.urlopen(req, timeout=30)
+    assert exc.value.code == 409
+
+
+def test_live_profile_rejects_bad_seconds(served):
+    base, _ = served
+    req = urllib.request.Request(
+        base + "/start_profile", data=json.dumps({"seconds": -1}).encode(),
+        headers={"Content-Type": "application/json"})
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        urllib.request.urlopen(req, timeout=30)
+    assert exc.value.code == 400
