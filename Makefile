@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: all native unit-test unit-test-fast unit-test-slow engine-test rag-test chaos kvq obs slo bench serve manager clean
+.PHONY: all native unit-test unit-test-fast unit-test-slow engine-test rag-test chaos kvq obs slo bench serve manager epp clean
 
 all: native
 
@@ -61,6 +61,13 @@ serve:
 
 manager:
 	$(PYTHON) -m kaito_tpu.controllers.manager
+
+# first-party endpoint picker (docs/routing.md): the scored routing
+# front the InferencePool extensionRef resolves to. BACKENDS is a
+# space-separated list of url[=role[/group]] replica specs.
+BACKENDS ?= http://127.0.0.1:5001
+epp:
+	$(PYTHON) -m kaito_tpu.runtime.epp $(foreach b,$(BACKENDS),--backend $(b))
 
 docker-engine:
 	docker build -f docker/engine/Dockerfile -t ghcr.io/kaito-tpu/engine:latest .

@@ -164,6 +164,7 @@ class InferenceSetReconciler(Reconciler):
 
         if self.gateway_api_enabled:
             self._ensure_inference_pool(iset)
+            self._ensure_epp(iset)
         return Result() if len(ready) >= want else Result(requeue_after=5.0)
 
     def _ensure_inference_pool(self, iset: InferenceSet) -> None:
@@ -183,6 +184,32 @@ class InferenceSetReconciler(Reconciler):
                 "selector": {LABEL_CREATED_BY_INFERENCESET: iset.metadata.name},
                 "extensionRef": {"name": f"{iset.metadata.name}-epp"},
             }))
+
+    def _ensure_epp(self, iset: InferenceSet) -> None:
+        """Render the endpoint picker the pool's extensionRef names
+        (docs/routing.md): a Deployment running
+        ``kaito_tpu.runtime.epp`` plus its Service.  The backend set is
+        the replica workspaces' Services, recomputed every reconcile so
+        scale-up/down keeps the picker's ``--backend`` args current."""
+        from kaito_tpu.manifests.epp import EPP_PORT, generate_epp_workload
+
+        ns = iset.metadata.namespace
+        backends = sorted(f"http://{c.metadata.name}:{EPP_PORT}"
+                          for c in self._children(iset))
+        objs = generate_epp_workload(
+            f"{iset.metadata.name}-epp", ns, backends=backends,
+            owner={"kind": "InferenceSet", "name": iset.metadata.name})
+        for obj in objs:
+            existing = self.store.try_get(obj.kind, ns, obj.metadata.name)
+            if existing is None:
+                self.store.create(obj)
+            elif (obj.kind == "Deployment"
+                  and existing.spec["template"]["spec"]["containers"][0]
+                  ["command"]
+                  != obj.spec["template"]["spec"]["containers"][0]
+                  ["command"]):
+                existing.spec = obj.spec
+                self.store.update(existing)
 
     def _set_cond(self, iset, type_, status, reason, message):
         def mutate(o):

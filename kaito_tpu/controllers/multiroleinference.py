@@ -79,6 +79,7 @@ class MultiRoleInferenceReconciler(Reconciler):
                               set_role)
 
         self._ensure_inference_pool(mri)
+        self._ensure_epp(mri)
         self._set_cond(mri, COND_MRI_READY,
                        "True" if all_ready else "False",
                        "Ready" if all_ready else "RolesPending",
@@ -131,6 +132,40 @@ class MultiRoleInferenceReconciler(Reconciler):
                 "extensionRef": {"name": f"{mri.metadata.name}-epp"},
                 "eppPluginsConfig": plugins,
             }))
+
+    def _ensure_epp(self, mri: MultiRoleInference) -> None:
+        """Render the PD-aware endpoint picker the pool's extensionRef
+        names: backend specs carry ``=role/group`` so the picker's
+        pd-filter and kv-locality-scorer can steer decode requests to
+        the prefill-owning replica group (docs/routing.md)."""
+        from kaito_tpu.api.workspace import LABEL_CREATED_BY_INFERENCESET
+        from kaito_tpu.manifests.epp import EPP_PORT, generate_epp_workload
+
+        ns = mri.metadata.namespace
+        backends = []
+        for ws in self.store.list("Workspace", ns,
+                                  labels={LABEL_MRI: mri.metadata.name}):
+            role = ws.metadata.labels.get(LABEL_ROLE, "")
+            group = ws.metadata.labels.get(LABEL_CREATED_BY_INFERENCESET, "")
+            backends.append(
+                f"http://{ws.metadata.name}:{EPP_PORT}={role}/{group}")
+        backends.sort()
+        plugins = mri.spec.epp_plugins_config or default_pd_plugins_config()
+        objs = generate_epp_workload(
+            f"{mri.metadata.name}-epp", ns, backends=backends,
+            plugins_config=plugins,
+            owner={"kind": "MultiRoleInference", "name": mri.metadata.name})
+        for obj in objs:
+            existing = self.store.try_get(obj.kind, ns, obj.metadata.name)
+            if existing is None:
+                self.store.create(obj)
+            elif (obj.kind == "Deployment"
+                  and existing.spec["template"]["spec"]["containers"][0]
+                  ["command"]
+                  != obj.spec["template"]["spec"]["containers"][0]
+                  ["command"]):
+                existing.spec = obj.spec
+                self.store.update(existing)
 
     def _set_cond(self, mri, type_, status, reason, message):
         def mutate(o):

@@ -200,9 +200,15 @@ class WorkspaceReconciler(Reconciler):
         # through kv_bytes_per_token)
         kv_dtype = ws.metadata.annotations.get(
             "kaito-tpu.io/kv-cache-dtype", "")
+        # CP prefill auto-carve is evidence-gated (plan_parallelism
+        # docstring: BENCH_r05 cp_speedup 0.68 < 1.0) — serve plans
+        # only carve a sequence axis when the user opts in
+        cp_opt_in = ws.metadata.annotations.get(
+            "kaito-tpu.io/cp-autocarve", "") == "true"
         plan = plan_parallelism(md, chip, workload=workload,
                                 target_chips=target,
-                                kv_dtype_bytes=1 if kv_dtype == "int8" else 2)
+                                kv_dtype_bytes=1 if kv_dtype == "int8" else 2,
+                                cp_autocarve=cp_opt_in)
         slice_spec = TPUSliceSpec(
             chip=chip, topology=plan.topology,
             machine_type=ws.resource.instance_type

@@ -432,6 +432,10 @@ class InferenceEngine:
             "prefill_steps_total": 0,
             "decode_steps_total": 0,
             "prefix_cached_tokens_total": 0,
+            # per-request prefix-cache outcome (routing layer scrapes
+            # these to judge affinity quality, docs/routing.md)
+            "prefix_cache_hits_total": 0,
+            "prefix_cache_misses_total": 0,
             "preemptions_total": 0,
             "host_kv_spilled_pages_total": 0,
             "host_kv_restored_pages_total": 0,
@@ -1802,6 +1806,14 @@ class InferenceEngine:
                 return True       # resumed from host pages, no prefill
             if cached:
                 self.counters["prefix_cached_tokens_total"] += cached
+            # hit/miss accounting only for requests that were ELIGIBLE
+            # for sharing (empty-token exclusive acquires are neither);
+            # resumes after preemption don't re-count
+            if (self.prefix_cache is not None and acquire_tokens
+                    and not req.preemptions):
+                key = ("prefix_cache_hits_total" if cached
+                       else "prefix_cache_misses_total")
+                self.counters[key] += 1
         except Exception:
             self._evict_slot(free_slot, commit=False)
             raise

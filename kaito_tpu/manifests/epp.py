@@ -1,0 +1,83 @@
+"""Endpoint-picker (EPP) workload rendering.
+
+Both controllers render an InferencePool whose ``extensionRef`` names
+``<cr>-epp``; these helpers render the Deployment + Service that make
+the ref resolve (docs/routing.md).  The picker is the in-repo
+``kaito_tpu.runtime.epp`` service: the backend set is passed as
+``--backend url[=role[/group]]`` args, recomputed by the owning
+reconciler whenever replicas come and go (the in-miniature analogue of
+the GAIE EPP watching pods behind the pool selector).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from kaito_tpu.api.meta import ObjectMeta
+from kaito_tpu.controllers.objects import Unstructured
+from kaito_tpu.manifests.core import generate_service
+from kaito_tpu.manifests.inference import DEFAULT_IMAGE
+
+EPP_PORT = 5000
+LABEL_EPP = "kaito-tpu.io/epp"
+
+
+def build_epp_command(backends: list[str], *,
+                      plugins_config: Optional[dict] = None,
+                      block_chars: int = 0) -> list[str]:
+    """The container command: one ``--backend`` per replica spec
+    (``url[=role[/group]]``), the plugin chain inline as JSON."""
+    cmd = ["python", "-m", "kaito_tpu.runtime.epp",
+           "--port", str(EPP_PORT)]
+    for spec in backends:
+        cmd += ["--backend", spec]
+    if plugins_config:
+        cmd += ["--plugins-config",
+                json.dumps(plugins_config, sort_keys=True)]
+    if block_chars:
+        cmd += ["--block-chars", str(block_chars)]
+    return cmd
+
+
+def generate_epp_workload(name: str, namespace: str, *,
+                          backends: list[str],
+                          owner: Optional[dict] = None,
+                          plugins_config: Optional[dict] = None,
+                          image: str = DEFAULT_IMAGE) -> list:
+    """Render the ``<name>`` (conventionally ``<cr>-epp``) Deployment +
+    Service the InferencePool's extensionRef resolves to."""
+    labels = {LABEL_EPP: name}
+    owners = [owner] if owner else []
+    deploy = Unstructured(
+        "Deployment",
+        ObjectMeta(name=name, namespace=namespace, labels=dict(labels),
+                   owner_references=list(owners)),
+        spec={
+            "replicas": 1,
+            "selector": {"matchLabels": dict(labels)},
+            "template": {
+                "metadata": {"labels": dict(labels)},
+                "spec": {
+                    "containers": [{
+                        "name": "epp",
+                        "image": image,
+                        "command": build_epp_command(
+                            backends, plugins_config=plugins_config),
+                        "ports": [{"containerPort": EPP_PORT}],
+                        "readinessProbe": {
+                            "httpGet": {"path": "/router/stats",
+                                        "port": EPP_PORT},
+                            "periodSeconds": 5,
+                        },
+                        "resources": {
+                            "requests": {"cpu": "500m", "memory": "1Gi"},
+                        },
+                    }],
+                },
+            },
+        })
+    svc = generate_service(name, namespace, labels, port=EPP_PORT,
+                           labels=labels)
+    svc.metadata.owner_references = list(owners)
+    return [deploy, svc]

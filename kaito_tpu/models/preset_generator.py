@@ -58,6 +58,10 @@ def main(argv=None):
                     choices=["bfloat16", "int8"],
                     help="KV pool dtype assumed by the plan preview "
                          "(int8 halves KV bytes/token)")
+    ap.add_argument("--cp-autocarve", action="store_true",
+                    help="opt the plan preview into the >=32k serve CP "
+                         "carve (evidence-gated off by default: BENCH_r05 "
+                         "cp_speedup_vs_chunked=0.68)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output")
     args = ap.parse_args(argv)
@@ -81,7 +85,8 @@ def main(argv=None):
         chip = CHIP_CATALOG[args.chip]
         plan = plan_parallelism(
             md, chip,
-            kv_dtype_bytes=1 if args.kv_cache_dtype == "int8" else 2)
+            kv_dtype_bytes=1 if args.kv_cache_dtype == "int8" else 2,
+            cp_autocarve=args.cp_autocarve)
         out["plan"] = {"chip": args.chip, "topology": plan.topology,
                        "num_slices": plan.num_slices,
                        "mesh": str(plan.mesh),

@@ -140,12 +140,23 @@ def plan_parallelism(
     kv_dtype_bytes: int = 2,
     quantization: Optional[str] = None,
     max_pipeline_stages: int = 8,
+    cp_autocarve: bool = False,
 ) -> ParallelPlan:
     """Plan mesh + slice shape for a model on a chip generation.
 
     ``target_chips`` (user's requested capacity, the analogue of the
     Workspace ``resource.count`` x instance size) raises the floor; the
     planner never returns fewer chips than the model needs.
+
+    ``cp_autocarve`` opts the SERVE path into carving a sequence axis
+    (ring-attention context-parallel prefill) at >= 32k context.  It
+    defaults OFF on measured evidence: BENCH_r05 shows
+    ``cp_speedup_seq4_vs_chunked = 0.68`` — CP prefill LOSES to chunked
+    prefill on the current kernel, so auto-carving would spend chips to
+    get slower.  Flip the default only once a benchmark round measures
+    ``cp_speedup_vs_chunked >= 1.0`` on real hardware (the train-path
+    carve is unaffected: ring attention there overlaps with grad
+    compute and is not subject to this evidence gate).
     """
     ctx = max_model_len or md.max_model_len
     notes: list[str] = []
@@ -228,7 +239,10 @@ def plan_parallelism(
         # (single-slice only: the pipeline serving executor owns its
         # mesh and has no sequence axis — carving one there would
         # reserve chips the engine never uses)
-        if ctx >= 32768 and leftover >= 2 and num_slices == 1 \
+        # opt-in only (cp_autocarve): see the evidence gate in the
+        # docstring — BENCH_r05 measured CP prefill at 0.68x chunked
+        if cp_autocarve and ctx >= 32768 and leftover >= 2 \
+                and num_slices == 1 \
                 and md.arch.attention_kind.value != "MLA":
             seq = 2
             while seq * 2 <= leftover and ctx // (seq * 2) >= 8192:

@@ -1,8 +1,18 @@
 """Data-parallel HTTP front: round-robin across N engine backends.
 
+This is now a THIN compatibility front over the shared routing data
+path in ``kaito_tpu/runtime/routing.py`` (docs/routing.md): the circuit
+breaker, ``/health`` prober, jittered idempotent retry, SSE byte relay,
+chunked-body handling, X-Request-Id propagation, and SIGTERM drain all
+live there, shared verbatim with the first-party endpoint picker
+(``kaito_tpu/runtime/epp.py``) that the InferencePool's ``extensionRef``
+resolves to.  What remains here is only the classic policy — blind
+round robin — plus the historical module surface that tests, dryruns
+and single-node deployments import.
+
 The in-miniature data plane of the repo's replica tier: in production,
 InferenceSet replicas sit behind the rendered Service/InferencePool and
-the GAIE EPP picks endpoints (``controllers/inferenceset.py``); the
+the EPP picks endpoints (``controllers/inferenceset.py``); the
 reference's analogue is vLLM ``--data-parallel-size`` over Ray plus its
 routing sidecar (``preset_inferences.go:909-985``).  This router is the
 same contract as ONE process you can boot in tests, dryruns, and
@@ -31,450 +41,42 @@ Failure-domain design (docs/failure-domains.md):
 from __future__ import annotations
 
 import argparse
-import http.client
-import json
 import logging
-import random
 import signal
 import threading
-import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
 
-from kaito_tpu.engine.metrics import Counter, Gauge, Histogram, Registry
-from kaito_tpu.utils.failpoints import FAILPOINTS, FailpointError
-from kaito_tpu.utils.tracing import (make_request_id, parse_traceparent,
-                                     sanitize_request_id)
+# Re-exported so existing imports (tests, helpers, bench harnesses)
+# keep working against the historical dp_router module surface.
+from kaito_tpu.runtime.routing import (BREAKER_THRESHOLD,  # noqa: F401
+                                       DOWN_COOLDOWN_MAX_S, DOWN_COOLDOWN_S,
+                                       HOP_HEADERS, IDEMPOTENT_POST_PREFIXES,
+                                       RETRY_BACKOFF_S, RETRY_CYCLES, Backend,
+                                       HealthProber, RoutingCore, _retryable,
+                                       make_routing_server)
 
 logger = logging.getLogger(__name__)
 
-DOWN_COOLDOWN_S = 5.0
-DOWN_COOLDOWN_MAX_S = 60.0
-BREAKER_THRESHOLD = 3          # consecutive failures that OPEN the breaker
-RETRY_CYCLES = 2               # full passes over the backend list
-RETRY_BACKOFF_S = 0.1          # jittered sleep between cycles
-HOP_HEADERS = {"connection", "keep-alive", "transfer-encoding",
-               "te", "trailer", "upgrade", "proxy-authorization"}
-# POST routes that are safe to replay against another replica before any
-# response byte: stateless inference (any replica computes the same
-# answer).  PD side-channel routes mutate per-replica staging state and
-# must NOT fail over blindly.
-IDEMPOTENT_POST_PREFIXES = ("/v1/completions", "/v1/chat/completions",
-                            "/v1/embeddings", "/score", "/tokenize",
-                            "/detokenize")
+# historical name: the backend class predates the shared routing lib
+_Backend = Backend
 
 
-class _Backend:
-    """One replica plus its circuit-breaker state.
+class DPRouter(RoutingCore):
+    """Round-robin chooser over backends, shared by handler threads.
 
-    ``down_until`` stays THE open-until timestamp (tests poke it to
-    heal a backend); ``failures`` counts CONSECUTIVE connect failures.
-    State is derived, never stored:
-
-    - ``open``      — cooling down (``down_until`` in the future)
-    - ``half-open`` — cooldown lapsed but the breaker tripped and no
-      success has closed it yet (the next request is the probe)
-    - ``closed``    — healthy
+    Pure policy: ``RoutingCore`` owns the breaker/drain/metrics state
+    and its default ``candidates`` IS round robin, so this subclass
+    only pins down the historical constructor (a list of URL strings).
     """
 
-    def __init__(self, url: str):
-        url = url.rstrip("/")
-        assert url.startswith("http://"), f"http backends only: {url}"
-        self.url = url
-        hostport = url[len("http://"):]
-        self.host, _, port = hostport.partition(":")
-        self.port = int(port or 80)
-        self.down_until = 0.0
-        self.served = 0
-        self.failures = 0
-
-    @property
-    def alive(self) -> bool:
-        return time.monotonic() >= self.down_until
-
-    @property
-    def state(self) -> str:
-        if not self.alive:
-            return "open"
-        if self.failures >= BREAKER_THRESHOLD:
-            return "half-open"
-        return "closed"
-
-    def mark_down(self) -> None:
-        """One more consecutive failure: cool down with exponential
-        backoff (capped) so a dead replica is probed ever less often
-        while it stays dead."""
-        self.failures += 1
-        backoff = min(DOWN_COOLDOWN_S * (2 ** max(0, self.failures
-                                                  - BREAKER_THRESHOLD)),
-                      DOWN_COOLDOWN_MAX_S)
-        self.down_until = time.monotonic() + backoff
-
-    def mark_up(self) -> None:
-        """A success (request or health probe) closes the breaker."""
-        self.failures = 0
-        self.down_until = 0.0
-
-
-_BREAKER_STATES = {"closed": 0, "half-open": 1, "open": 2}
-
-
-class DPRouter:
-    """Round-robin chooser over backends, shared by handler threads."""
-
     def __init__(self, backends: list[str]):
-        if not backends:
-            raise ValueError("dp router needs at least one backend")
-        self.backends = [_Backend(u) for u in backends]
-        self._rr = 0
-        self._lock = threading.Lock()
-        self.draining = False
-        self._inflight = 0
-        # router's OWN /metrics (docs/observability.md): the engine
-        # replicas each expose theirs; these series cover the relay tier
-        r = Registry()
-        self.registry = r
-        self.m_forwarded = Counter(
-            "kaito:router_requests_forwarded_total",
-            "Requests relayed to a backend (response head received)",
-            r, labels=("backend",))
-        self.m_retries = Counter(
-            "kaito:router_retries_total",
-            "Relay attempts beyond each request's first", r,
-            labels=("backend",))
-        self.m_failures = Counter(
-            "kaito:router_backend_failures_total",
-            "Connect/forward failures that skipped a backend", r,
-            labels=("backend",))
-        self.upstream_latency = Histogram(
-            "kaito:router_upstream_latency_seconds",
-            "Forward-to-response-head latency per backend", r,
-            buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
-                     0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0),
-            labels=("backend",))
-        # breaker state is time-derived (down_until vs now), so the
-        # family is computed at scrape time via the labelled-fn Gauge
-        Gauge("kaito:router_backend_breaker_state",
-              "Circuit breaker per backend (0=closed, 1=half-open, 2=open)",
-              r, labels=("backend",),
-              fn=lambda: {(b.url,): _BREAKER_STATES[b.state]
-                          for b in self.backends})
-
-    def next_backend(self) -> Optional[_Backend]:
-        """Next live backend (round robin), or the next one regardless
-        if every backend is cooling down (better a refused retry than a
-        guaranteed 503 when all marks are stale)."""
-        with self._lock:
-            n = len(self.backends)
-            for offset in range(n):
-                b = self.backends[(self._rr + offset) % n]
-                if b.alive:
-                    self._rr = (self._rr + offset + 1) % n
-                    b.served += 1
-                    return b
-            b = self.backends[self._rr % n]
-            self._rr = (self._rr + 1) % n
-            b.served += 1
-            return b
-
-    def stats(self) -> dict:
-        with self._lock:
-            return {b.url: {"served": b.served, "alive": b.alive,
-                            "state": b.state, "failures": b.failures}
-                    for b in self.backends}
-
-    # -- drain bookkeeping -------------------------------------------------
-    def begin_request(self) -> bool:
-        """Admission gate: False while draining (caller answers 503)."""
-        with self._lock:
-            if self.draining:
-                return False
-            self._inflight += 1
-            return True
-
-    def end_request(self) -> None:
-        with self._lock:
-            self._inflight -= 1
-
-    @property
-    def inflight(self) -> int:
-        with self._lock:
-            return self._inflight
-
-    def drain(self, timeout_s: float = 30.0) -> bool:
-        """Stop accepting, wait for in-flight relays to finish.  Returns
-        True when the router went quiet inside the timeout."""
-        with self._lock:
-            self.draining = True
-        deadline = time.monotonic() + timeout_s
-        while time.monotonic() < deadline:
-            if self.inflight == 0:
-                return True
-            time.sleep(0.05)
-        return self.inflight == 0
+        super().__init__(backends)
 
 
-class HealthProber(threading.Thread):
-    """Background ``/health`` probe per backend: closes breakers as
-    replicas recover, opens them when a live-looking backend refuses
-    the probe — without spending client requests on discovery."""
-
-    def __init__(self, router: DPRouter, interval_s: float = 2.0):
-        super().__init__(daemon=True, name="dp-health-prober")
-        self.router = router
-        self.interval_s = interval_s
-        self._stop = threading.Event()
-
-    def stop(self) -> None:
-        self._stop.set()
-
-    def run(self) -> None:
-        while not self._stop.wait(self.interval_s):
-            for b in self.router.backends:
-                try:
-                    conn = http.client.HTTPConnection(b.host, b.port,
-                                                      timeout=5)
-                    try:
-                        conn.request("GET", "/health")
-                        ok = conn.getresponse().status == 200
-                    finally:
-                        conn.close()
-                except (ConnectionError, OSError):
-                    ok = False
-                if ok:
-                    if b.failures:
-                        logger.info("health probe: %s recovered", b.url)
-                    b.mark_up()
-                elif b.alive:
-                    b.mark_down()
-
-
-def _retryable(method: str, path: str) -> bool:
-    """May this request be replayed against another replica (before any
-    response byte)?  GET/DELETE always; POST only on the stateless
-    inference routes."""
-    if method in ("GET", "DELETE", "HEAD"):
-        return True
-    if method == "POST":
-        return any(path.startswith(p) for p in IDEMPOTENT_POST_PREFIXES)
-    return False
-
-
-def make_router_server(router: DPRouter, host: str = "0.0.0.0",
-                       port: int = 0,
-                       probe_interval_s: float = 0.0) -> ThreadingHTTPServer:
-    class Handler(BaseHTTPRequestHandler):
-        protocol_version = "HTTP/1.1"
-
-        def log_message(self, *a):
-            pass
-
-        def _send_json(self, code: int, obj: dict,
-                       headers: Optional[dict] = None) -> None:
-            body = json.dumps(obj).encode()
-            self.send_response(code)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(body)))
-            rid = getattr(self, "_rid", None)
-            if rid:
-                self.send_header("X-Request-Id", rid)
-            for k, v in (headers or {}).items():
-                self.send_header(k, str(v))
-            self.end_headers()
-            self.wfile.write(body)
-
-        def _read_request_body(self) -> Optional[bytes]:
-            """Read the client body whichever way it was framed.  A
-            ``Transfer-Encoding: chunked`` body is DE-CHUNKED here and
-            forwarded with Content-Length (http.client sets it), so a
-            chunked client upload is no longer silently dropped."""
-            te = (self.headers.get("Transfer-Encoding") or "").lower()
-            if "chunked" in te:
-                chunks = []
-                while True:
-                    size_line = self.rfile.readline(65536).strip()
-                    size = int(size_line.split(b";")[0] or b"0", 16)
-                    if size == 0:
-                        # consume trailers until the blank line
-                        while self.rfile.readline(65536).strip():
-                            pass
-                        break
-                    chunks.append(self.rfile.read(size))
-                    self.rfile.read(2)          # CRLF after each chunk
-                return b"".join(chunks)
-            length = int(self.headers.get("Content-Length") or 0)
-            return self.rfile.read(length) if length else None
-
-        def _relay(self, method: str):
-            # end-to-end tracing: accept the caller's X-Request-Id (or
-            # a W3C traceparent), mint one otherwise, and forward it so
-            # router + engine logs/spans correlate on one id.
-            self._rid = (sanitize_request_id(self.headers.get("X-Request-Id"))
-                         or parse_traceparent(self.headers.get("traceparent"))
-                         or make_request_id())
-            if self.path == "/router/stats":
-                self._send_json(200, router.stats())
-                return
-            if self.path == "/metrics" and method == "GET":
-                # the router's OWN series, never forwarded: per-backend
-                # forwards/retries/failures, breaker state, latency
-                body = router.registry.expose().encode()
-                self.send_response(200)
-                self.send_header("Content-Type",
-                                 "text/plain; version=0.0.4")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-                return
-            if not router.begin_request():
-                self._send_json(503, {"error": "router draining"},
-                                headers={"Retry-After": 1})
-                return
-            try:
-                self._relay_inner(method)
-            finally:
-                router.end_request()
-
-        def _relay_inner(self, method: str):
-            try:
-                body = self._read_request_body()
-            except (ValueError, ConnectionError, OSError):
-                self._send_json(400, {"error": "malformed request body"})
-                return
-            # failover is only safe BEFORE the first response byte: a
-            # backend that dies mid-stream cannot be retried without
-            # corrupting the client's half-written reply (and without
-            # re-running the inference) — abort the connection instead.
-            # Retryable requests get RETRY_CYCLES full passes over the
-            # list with a jittered backoff between passes; one-shot
-            # (non-idempotent) requests get a single pass.
-            retryable = _retryable(method, self.path)
-            cycles = RETRY_CYCLES if retryable else 1
-            last_status: Optional[int] = None
-            attempts = 0
-            for cycle in range(cycles):
-                if cycle:
-                    time.sleep(RETRY_BACKOFF_S * (1 + random.random()))
-                tried = 0
-                while tried < len(router.backends):
-                    b = router.next_backend()
-                    tried += 1
-                    attempts += 1
-                    if attempts > 1:
-                        router.m_retries.inc(backend=b.url)
-                    t_fwd = time.monotonic()
-                    try:
-                        resp, conn = self._connect(b, method, body)
-                    except (ConnectionError, OSError, FailpointError) as e:
-                        logger.warning("backend %s unreachable (%s); "
-                                       "skipping", b.url, e)
-                        router.m_failures.inc(backend=b.url)
-                        b.mark_down()
-                        continue
-                    router.upstream_latency.observe(
-                        time.monotonic() - t_fwd, backend=b.url)
-                    if retryable and resp.status in (502, 503) \
-                            and (cycle + 1 < cycles
-                                 or tried < len(router.backends)):
-                        # the replica answered but cannot serve (loading
-                        # stub, drain, overload): try elsewhere.  The
-                        # breaker does NOT trip — the process is alive.
-                        last_status = resp.status
-                        conn.close()
-                        continue
-                    b.mark_up()
-                    router.m_forwarded.inc(backend=b.url)
-                    self._stream_response(b, method, resp, conn)
-                    return
-            self._send_json(503 if last_status is None else last_status,
-                            {"error": "no live backend"},
-                            headers={"Retry-After": 1})
-
-        def _connect(self, b: _Backend, method: str,
-                     body: Optional[bytes]):
-            """Send the request and read the response HEAD; raises are
-            retryable (nothing has reached the client yet)."""
-            FAILPOINTS.fire("router.forward", backend=b.url)
-            conn = http.client.HTTPConnection(b.host, b.port, timeout=600)
-            headers = {k: v for k, v in self.headers.items()
-                       if k.lower() not in HOP_HEADERS
-                       and k.lower() not in ("content-length",
-                                             "x-request-id")}
-            headers["X-Request-Id"] = self._rid
-            conn.request(method, self.path, body=body, headers=headers)
-            return conn.getresponse(), conn
-
-        def _stream_response(self, b: _Backend, method: str, resp,
-                             conn) -> None:
-            """Relay an already-open backend response.  A BACKEND read
-            failure marks it down and aborts the client connection (no
-            retry — bytes are already out); a CLIENT write failure just
-            ends the relay (the backend is healthy)."""
-            try:
-                self.send_response(resp.status)
-                for k, v in resp.getheaders():
-                    if k.lower() not in HOP_HEADERS:
-                        self.send_header(k, v)
-                # 1xx/204/304 (and HEAD replies) carry NO body by spec:
-                # chunked framing (or a terminator) after their headers
-                # would corrupt the connection for the next request
-                bodyless = (resp.status < 200 or resp.status in (204, 304)
-                            or method == "HEAD")
-                has_len = resp.getheader("Content-Length") is not None
-                if not has_len and not bodyless:
-                    # stream of unknown length (SSE): relay chunked
-                    self.send_header("Transfer-Encoding", "chunked")
-                self.end_headers()
-                if bodyless:
-                    return
-                # relay bytes AS THEY ARRIVE so SSE tokens stream through
-                while True:
-                    try:
-                        chunk = resp.read1(65536) if hasattr(resp, "read1") \
-                            else resp.read(65536)
-                    except (ConnectionError, OSError) as e:
-                        logger.warning("backend %s died mid-stream (%s); "
-                                       "aborting relay", b.url, e)
-                        b.mark_down()
-                        self.close_connection = True
-                        return
-                    if not chunk:
-                        break
-                    try:
-                        if has_len:
-                            self.wfile.write(chunk)
-                        else:
-                            self.wfile.write(
-                                b"%x\r\n%s\r\n" % (len(chunk), chunk))
-                        self.wfile.flush()
-                    except (ConnectionError, OSError):
-                        # client went away: backend stays healthy
-                        self.close_connection = True
-                        return
-                if not has_len:
-                    try:
-                        self.wfile.write(b"0\r\n\r\n")
-                    except (ConnectionError, OSError):
-                        self.close_connection = True
-            finally:
-                conn.close()
-
-        def do_GET(self):
-            self._relay("GET")
-
-        def do_POST(self):
-            self._relay("POST")
-
-        def do_DELETE(self):
-            self._relay("DELETE")
-
-    srv = ThreadingHTTPServer((host, port), Handler)
-    srv.router = router                      # type: ignore[attr-defined]
-    if probe_interval_s > 0:
-        prober = HealthProber(router, probe_interval_s)
-        prober.start()
-        srv.prober = prober                  # type: ignore[attr-defined]
-    return srv
+def make_router_server(router, host: str = "0.0.0.0", port: int = 0,
+                       probe_interval_s: float = 0.0):
+    """Historical entry point; the relay itself is the shared one."""
+    return make_routing_server(router, host, port,
+                               probe_interval_s=probe_interval_s)
 
 
 def main(argv=None):

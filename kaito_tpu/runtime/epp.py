@@ -1,0 +1,352 @@
+"""First-party endpoint picker (EPP) behind the InferencePool.
+
+Both controllers render an InferencePool whose ``extensionRef`` names
+``<name>-epp`` (``controllers/inferenceset.py``,
+``controllers/multiroleinference.py``); this module is that picker.
+It rides the shared routing data path (``runtime/routing.py`` — same
+breaker/retry/SSE relay/drain as the round-robin dp_router front) and
+replaces only the candidate ORDER with a scored one (docs/routing.md):
+
+1. **Prefix-hash affinity** — a bounded LRU of recent prompt-prefix
+   block hashes per backend, block size aligned to the engine's
+   prefix-cache page size, so repeated-prefix traffic lands on the
+   replica whose radix tree already holds the KV (SGLang-style
+   cache-aware routing).
+2. **Live load** — ``kaito:batch_occupancy``, queue depth, and KV
+   utilization scraped from each replica's ``/metrics``; hysteresis
+   (enter-high/exit-low watermarks) keeps affinity from steering onto
+   a saturated or breaker-open backend.
+3. **PD plugin chain** — decode requests carrying a staged-KV
+   ``kv_transfer`` handle steer to the prefill-owning replica (or its
+   group), honoring the MultiRoleInference ``eppPluginsConfig`` chain
+   (pd-filter / kv-locality-scorer / queue-depth-scorer).
+
+The picker exports its own ``kaito:epp_*`` series next to the shared
+``kaito:router_*`` transport families on ``/metrics``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import signal
+import threading
+from typing import Iterable, Optional
+
+from kaito_tpu.engine.metrics import Counter, Gauge, Registry
+from kaito_tpu.runtime.routing import (Backend, PrefixAffinityIndex,
+                                       RoutingCore, make_routing_server,
+                                       prefix_blocks)
+
+logger = logging.getLogger(__name__)
+
+# With no tokenizer in the picker, block size is expressed in CHARS and
+# aligned to the engine's KV page size (tokens) via a chars-per-token
+# estimate: ~4 chars/token is the usual English/BPE rule of thumb, and
+# over-estimating only makes affinity blocks COARSER than engine pages
+# (a char-block hit still maps onto whole cached pages).
+CHARS_PER_TOKEN = 4
+DEFAULT_BLOCK_CHARS = 64       # engine default page_size=16 tokens * 4
+
+# score weight that dominates load terms when most prefix blocks match
+AFFINITY_WEIGHT = 3.0
+
+
+def default_epp_plugins_config() -> dict:
+    """Standalone (InferenceSet) chain: no roles to filter, so the
+    pd-filter is a no-op and affinity + load do the work."""
+    return {
+        "plugins": [
+            {"type": "pd-filter"},
+            {"type": "prefix-affinity-scorer", "weight": AFFINITY_WEIGHT},
+            {"type": "kv-locality-scorer", "weight": 2},
+            {"type": "queue-depth-scorer", "weight": 1},
+            {"type": "kv-load-scorer", "weight": 1},
+        ],
+    }
+
+
+class RequestCtx:
+    """Everything scoring needs, parsed once per request."""
+
+    __slots__ = ("blocks", "matched", "kv_source", "want_role", "steered")
+
+    def __init__(self):
+        self.blocks: list[int] = []            # prompt prefix block hashes
+        self.matched: dict[str, int] = {}      # url -> consecutive hits
+        self.kv_source: str = ""               # kv_transfer.source_url
+        self.want_role: str = ""               # "", "prefill", "decode"
+        self.steered = False                   # PD locality won the pick
+
+
+def _extract_prompt(body: Optional[bytes]) -> str:
+    """Best-effort prompt text from an inference request body; any
+    parse failure just means no affinity signal for this request."""
+    if not body:
+        return ""
+    try:
+        obj = json.loads(body)
+    except (ValueError, UnicodeDecodeError):
+        return ""
+    if not isinstance(obj, dict):
+        return ""
+    prompt = obj.get("prompt")
+    if isinstance(prompt, str):
+        return prompt
+    msgs = obj.get("messages")
+    if isinstance(msgs, list):
+        # role markers included so "same content, different role" maps
+        # to different blocks (mirrors the chat-template expansion)
+        parts = []
+        for m in msgs:
+            if isinstance(m, dict):
+                parts.append(f"<{m.get('role', '')}>"
+                             f"{m.get('content', '')}")
+        return "".join(parts)
+    return ""
+
+
+def _extract_kv_source(body: Optional[bytes]) -> str:
+    if not body:
+        return ""
+    try:
+        obj = json.loads(body)
+    except (ValueError, UnicodeDecodeError):
+        return ""
+    if not isinstance(obj, dict):
+        return ""
+    kt = obj.get("kv_transfer")
+    if isinstance(kt, dict):
+        src = kt.get("source_url")
+        if isinstance(src, str):
+            return src.rstrip("/")
+    return ""
+
+
+class EndpointPicker(RoutingCore):
+    """Scored candidate ordering over the shared routing transport."""
+
+    def __init__(self, backends: list, *, block_chars: int = 0,
+                 index_capacity: int = 65536,
+                 plugins_config: Optional[dict] = None,
+                 registry: Optional[Registry] = None):
+        super().__init__(backends, registry)
+        self._block_chars = block_chars        # 0 = auto from kv_page_size
+        self.index = PrefixAffinityIndex(index_capacity)
+        cfg = plugins_config or default_epp_plugins_config()
+        self.plugins = [(p.get("type", ""), float(p.get("weight", 1)))
+                        for p in cfg.get("plugins", [])
+                        if isinstance(p, dict)]
+        r = self.registry
+        self.m_picks = Counter(
+            "kaito:epp_picks_total",
+            "Requests the picker routed, per chosen backend", r,
+            labels=("backend",))
+        self.m_affinity_hits = Counter(
+            "kaito:epp_affinity_hits_total",
+            "Requests landed on a backend already holding prefix blocks",
+            r)
+        self.m_affinity_misses = Counter(
+            "kaito:epp_affinity_misses_total",
+            "Requests with prefix signal but no (usable) block owner", r)
+        self.m_pd_steered = Counter(
+            "kaito:epp_pd_steered_total",
+            "Decode requests steered to the staged-KV owner or its group",
+            r)
+        Gauge("kaito:epp_backend_saturated",
+              "Hysteresis saturation per backend (1 = affinity excluded)",
+              r, labels=("backend",),
+              fn=lambda: {(b.url,): float(b.saturated)
+                          for b in self.backends})
+        Gauge("kaito:epp_affinity_index_size",
+              "Distinct prefix block hashes currently indexed", r,
+              fn=lambda: float(len(self.index)))
+        Gauge("kaito:epp_affinity_index_evictions",
+              "Prefix block hashes evicted from the LRU index", r,
+              fn=lambda: float(self.index.evictions))
+
+    # -- affinity block size ----------------------------------------------
+    @property
+    def block_chars(self) -> int:
+        """Char-block size for prefix hashing: explicit override, else
+        the engine's scraped ``kaito:kv_page_size`` (tokens) times the
+        chars-per-token estimate, else the engine-default fallback —
+        keeping affinity blocks aligned with what the radix tree can
+        actually reuse."""
+        if self._block_chars > 0:
+            return self._block_chars
+        pages = [b.load.page_size for b in self.backends
+                 if b.load.page_size > 0]
+        if pages:
+            return int(max(pages)) * CHARS_PER_TOKEN
+        return DEFAULT_BLOCK_CHARS
+
+    # -- scoring -----------------------------------------------------------
+    def make_ctx(self, method: str, path: str,
+                 body: Optional[bytes]) -> RequestCtx:
+        ctx = RequestCtx()
+        if method != "POST":
+            return ctx
+        if path.startswith("/pd/prefill"):
+            ctx.want_role = "prefill"
+        kv_source = _extract_kv_source(body)
+        if kv_source:
+            ctx.kv_source = kv_source
+            ctx.want_role = ctx.want_role or "decode"
+        prompt = _extract_prompt(body)
+        if prompt:
+            ctx.blocks = prefix_blocks(prompt, self.block_chars)
+            if ctx.blocks:
+                ctx.matched = self.index.match(ctx.blocks)
+        return ctx
+
+    def _filter_role(self, ctx: RequestCtx,
+                     pool: list[Backend]) -> list[Backend]:
+        """pd-filter: keep replicas whose role can serve this request.
+        Unlabelled ("") and "both" backends always qualify; when no
+        backend matches (homogeneous pool), the filter is a no-op."""
+        if not ctx.want_role:
+            return pool
+        kept = [b for b in pool
+                if b.role in ("", "both", ctx.want_role)]
+        return kept or pool
+
+    def _score(self, b: Backend, ctx: RequestCtx) -> float:
+        """Weighted plugin-chain sum; each scorer yields [0, 1]."""
+        total = 0.0
+        for ptype, weight in self.plugins:
+            if ptype == "prefix-affinity-scorer":
+                # a saturated or breaker-tripped backend never earns
+                # affinity — steering onto it would trade a cache hit
+                # for queueing (or a connect failure)
+                if ctx.blocks and not b.saturated and b.state == "closed":
+                    total += weight * (ctx.matched.get(b.url, 0)
+                                       / len(ctx.blocks))
+            elif ptype == "kv-locality-scorer":
+                if ctx.kv_source:
+                    if b.url == ctx.kv_source:
+                        # colocated decode: device-to-device handoff
+                        total += weight
+                    elif b.group and b.group == self._source_group(ctx):
+                        total += weight * 0.5
+            elif ptype == "queue-depth-scorer":
+                total += weight / (1.0 + b.load.waiting)
+            elif ptype == "kv-load-scorer":
+                total += weight * (1.0 - min(1.0, max(
+                    b.load.kv_usage, b.load.occupancy)))
+            # pd-filter participates as a filter, not a scorer;
+            # unknown plugin types are ignored (forward compat)
+        return total
+
+    def _source_group(self, ctx: RequestCtx) -> str:
+        for b in self.backends:
+            if b.url == ctx.kv_source:
+                return b.group
+        return ""
+
+    def candidates(self, method: str, path: str,
+                   ctx) -> Iterable[Backend]:
+        """Alive candidates in descending score order, then cooling-down
+        backends as a last resort (same never-0-candidates guarantee as
+        the round-robin front)."""
+        if not isinstance(ctx, RequestCtx):
+            ctx = RequestCtx()
+        pool = self._filter_role(ctx, list(self.backends))
+        alive = [b for b in pool if b.alive]
+        dead = [b for b in pool if not b.alive]
+        # stable sort: score ties fall back to least-loaded-first order
+        alive.sort(key=lambda b: (-self._score(b, ctx), b.load.waiting))
+        for b in alive + dead:
+            with self._lock:
+                b.served += 1
+            yield b
+
+    def note_response(self, backend: Backend, ctx,
+                      status: int) -> None:
+        """A response head arrived: account the pick and feed the
+        affinity index (the chosen replica now holds this prefix)."""
+        self.m_picks.inc(backend=backend.url)
+        if not isinstance(ctx, RequestCtx):
+            return
+        if ctx.kv_source and not ctx.steered and (
+                backend.url == ctx.kv_source
+                or (backend.group
+                    and backend.group == self._source_group(ctx))):
+            ctx.steered = True         # count once per request
+            self.m_pd_steered.inc()
+        if ctx.blocks:
+            if ctx.matched.get(backend.url, 0) > 0:
+                self.m_affinity_hits.inc()
+            else:
+                self.m_affinity_misses.inc()
+            if status < 500:
+                self.index.record(ctx.blocks, backend.url)
+
+
+def _parse_backend_arg(spec: str) -> Backend:
+    """``url[=role[/group]]`` — e.g. ``http://p0:5000=prefill/g0``."""
+    url, _, rolegroup = spec.partition("=")
+    role, _, group = rolegroup.partition("/")
+    return Backend(url, role=role, group=group)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="kaito-tpu-epp")
+    ap.add_argument("--backend", action="append", required=True,
+                    help="backend spec url[=role[/group]] (repeat per "
+                         "replica); role in {prefill,decode,both}")
+    ap.add_argument("--port", type=int, default=5000)
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--block-chars", type=int, default=0,
+                    help="affinity block size in chars (0 = derive from "
+                         "the scraped engine kv_page_size)")
+    ap.add_argument("--index-capacity", type=int, default=65536,
+                    help="max distinct prefix block hashes kept (LRU)")
+    ap.add_argument("--plugins-config", default="",
+                    help="plugin-chain JSON (inline, or @path to a file "
+                         "— the InferencePool's eppPluginsConfig)")
+    ap.add_argument("--health-probe-interval-s", type=float, default=2.0,
+                    help="per-backend /health probe cadence (0 = off)")
+    ap.add_argument("--scrape-interval-s", type=float, default=1.0,
+                    help="per-backend /metrics load scrape cadence "
+                         "(0 = off)")
+    ap.add_argument("--drain-timeout-s", type=float, default=30.0,
+                    help="SIGTERM grace: max seconds to finish in-flight "
+                         "requests before exit")
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    plugins_config = None
+    if args.plugins_config:
+        raw = args.plugins_config
+        if raw.startswith("@"):
+            with open(raw[1:], encoding="utf-8") as f:
+                raw = f.read()
+        plugins_config = json.loads(raw)
+
+    picker = EndpointPicker(
+        [_parse_backend_arg(s) for s in args.backend],
+        block_chars=args.block_chars,
+        index_capacity=args.index_capacity,
+        plugins_config=plugins_config)
+    srv = make_routing_server(picker, args.host, args.port,
+                              probe_interval_s=args.health_probe_interval_s,
+                              scrape_interval_s=args.scrape_interval_s)
+
+    def _term(signum, frame):
+        logger.info("SIGTERM: draining %d in-flight request(s)",
+                    picker.inflight)
+        threading.Thread(target=lambda: (picker.drain(args.drain_timeout_s),
+                                         srv.shutdown()),
+                         daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _term)
+    logger.info("epp on :%d -> %s", srv.server_address[1],
+                [b.url for b in picker.backends])
+    srv.serve_forever()
+    logger.info("epp exited cleanly")
+
+
+if __name__ == "__main__":
+    main()

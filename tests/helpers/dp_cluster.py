@@ -1,8 +1,12 @@
 """Data-parallel 2-process serving: N independent single-process engine
 servers (each its own OS process and jax runtime) behind the in-repo
-DP router (kaito_tpu/runtime/dp_router.py) — the replica tier's data
-plane over REAL process boundaries, used by tests/test_dp_router.py
-and the driver's dp-over-2-procs dryrun leg."""
+routing tier — the replica data plane over REAL process boundaries.
+
+``boot_backends`` spawns just the replicas (used to compare fronts over
+one shared pool); ``boot_dp`` adds the round-robin dp_router front
+(tests/test_dp_router.py and the driver's dp-over-2-procs dryrun leg);
+``boot_epp`` adds the scored endpoint-picker front
+(kaito_tpu/runtime/epp.py, tests/test_epp.py)."""
 
 from __future__ import annotations
 
@@ -18,11 +22,10 @@ from tests.helpers.mh_cluster import REPO, free_port
 
 
 @contextmanager
-def boot_dp(n_backends: int = 2, extra_args=(), timeout_s: float = 240.0):
-    """Yield (router_url, backend_urls, router) with every backend
-    healthy behind the round-robin front."""
-    from kaito_tpu.runtime.dp_router import DPRouter, make_router_server
-
+def boot_backends(n_backends: int = 2, extra_args=(),
+                  timeout_s: float = 240.0):
+    """Yield a list of base urls, one per healthy engine-server
+    process."""
     ports = [free_port() for _ in range(n_backends)]
     procs = []
     try:
@@ -58,14 +61,7 @@ def boot_dp(n_backends: int = 2, extra_args=(), timeout_s: float = 240.0):
                             break
                 except Exception:
                     time.sleep(1.0)
-        router = DPRouter(urls)
-        srv = make_router_server(router, host="127.0.0.1", port=0)
-        threading.Thread(target=srv.serve_forever, daemon=True).start()
-        try:
-            yield (f"http://127.0.0.1:{srv.server_address[1]}", urls,
-                   router)
-        finally:
-            srv.shutdown()
+        yield urls
     finally:
         for p in procs:
             p.terminate()
@@ -74,3 +70,46 @@ def boot_dp(n_backends: int = 2, extra_args=(), timeout_s: float = 240.0):
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.kill()
+
+
+@contextmanager
+def serve_front(core, **server_kw):
+    """Run a routing front (any RoutingCore) on a loopback port; yield
+    its base url."""
+    from kaito_tpu.runtime.routing import make_routing_server
+
+    srv = make_routing_server(core, host="127.0.0.1", port=0, **server_kw)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        yield f"http://127.0.0.1:{srv.server_address[1]}"
+    finally:
+        srv.shutdown()
+        if getattr(srv, "scraper", None):
+            srv.scraper.stop()
+        if getattr(srv, "prober", None):
+            srv.prober.stop()
+
+
+@contextmanager
+def boot_dp(n_backends: int = 2, extra_args=(), timeout_s: float = 240.0):
+    """Yield (router_url, backend_urls, router) with every backend
+    healthy behind the round-robin front."""
+    from kaito_tpu.runtime.dp_router import DPRouter
+
+    with boot_backends(n_backends, extra_args, timeout_s) as urls:
+        router = DPRouter(urls)
+        with serve_front(router) as router_url:
+            yield router_url, urls, router
+
+
+@contextmanager
+def boot_epp(n_backends: int = 2, extra_args=(), timeout_s: float = 240.0,
+             **picker_kw):
+    """Yield (picker_url, backend_urls, picker) behind the scored
+    endpoint-picker front."""
+    from kaito_tpu.runtime.epp import EndpointPicker
+
+    with boot_backends(n_backends, extra_args, timeout_s) as urls:
+        picker = EndpointPicker(urls, **picker_kw)
+        with serve_front(picker) as picker_url:
+            yield picker_url, urls, picker

@@ -1,0 +1,511 @@
+"""First-party endpoint picker (kaito_tpu/runtime/epp.py) behind the
+InferencePool: prefix-hash affinity, hysteresis saturation, the PD
+plugin chain, controller-rendered EPP workloads resolving the pool's
+``extensionRef`` — and the e2e proof that the scored front beats the
+round-robin dp_router on prefix-cache hit rate and TTFT over real
+process boundaries (docs/routing.md)."""
+
+import json
+import time
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from kaito_tpu.runtime.epp import (
+    DEFAULT_BLOCK_CHARS,
+    EndpointPicker,
+    _parse_backend_arg,
+    default_epp_plugins_config,
+)
+from kaito_tpu.runtime.routing import (
+    BREAKER_THRESHOLD,
+    Backend,
+    PrefixAffinityIndex,
+    parse_load_metrics,
+    prefix_blocks,
+    update_saturation,
+)
+
+
+# ---------------------------------------------------------------------------
+# prefix hashing + the affinity hash ring
+# ---------------------------------------------------------------------------
+
+def test_prefix_blocks_chained_and_page_aligned():
+    # equal block CONTENT at different depths hashes differently (the
+    # engine's radix tree chains page hashes the same way)
+    b = prefix_blocks("abcdabcd", 4)
+    assert len(b) == 2 and b[0] != b[1]
+    # prefix property: a longer prompt extends, never rewrites, the chain
+    assert prefix_blocks("abcdabcdzzzz", 4)[:2] == b
+    # trailing partial blocks are dropped (no whole KV page => no hit)
+    assert prefix_blocks("abcdab", 4) == b[:1]
+    assert prefix_blocks("abc", 4) == []
+    assert prefix_blocks("anything", 0) == []
+
+
+def test_affinity_index_counts_consecutive_leading_blocks():
+    idx = PrefixAffinityIndex(capacity=16)
+    idx.record([1, 2, 3], "http://a")
+    idx.record([1, 2], "http://b")
+    assert idx.match([1, 2, 3]) == {"http://a": 3, "http://b": 2}
+    # a hole at block 0 means NOTHING downstream can hit
+    assert idx.match([9, 1, 2]) == {}
+    # a backend missing block k stops at k even if it owns k+1
+    idx.record([3], "http://c")
+    assert "http://c" not in idx.match([1, 2, 3])
+
+
+def test_affinity_index_lru_eviction_is_bounded():
+    idx = PrefixAffinityIndex(capacity=3)
+    idx.record([1, 2, 3], "http://a")
+    idx.record([4], "http://a")
+    assert len(idx) == 3
+    assert idx.evictions == 1
+    assert idx.match([1]) == {}              # oldest hash evicted
+    assert idx.match([2]) == {"http://a": 1}
+    # matching touches entries, so a hot chain survives new inserts
+    idx.match([2, 3])
+    idx.record([5], "http://a")
+    assert idx.match([2]) == {"http://a": 1}
+
+
+def test_affinity_index_drop_backend_forgets_stale_kv():
+    idx = PrefixAffinityIndex(capacity=16)
+    idx.record([1, 2], "http://a")
+    idx.record([1], "http://b")
+    idx.drop_backend("http://a")
+    assert idx.match([1, 2]) == {"http://b": 1}
+    assert len(idx) == 1                     # hash 2 had no owners left
+
+
+def test_saturation_hysteresis_enter_high_exit_low():
+    b = Backend("http://x:1")
+    b.load.occupancy = 0.95
+    assert update_saturation(b) is True
+    # inside the band: stays saturated (no flapping at the threshold)
+    b.load.occupancy = 0.80
+    assert update_saturation(b) is True
+    b.load.occupancy = 0.60
+    assert update_saturation(b) is False
+    # any single high watermark re-enters
+    b.load.waiting = 9
+    assert update_saturation(b) is True
+    b.load.waiting = 3                       # still above the low mark
+    assert update_saturation(b) is True
+    b.load.waiting = 0
+    assert update_saturation(b) is False
+
+
+def test_parse_load_metrics_sums_queue_and_averages_utilization():
+    text = "\n".join([
+        "# HELP kaito:num_requests_waiting q",
+        'kaito:num_requests_waiting{group="0"} 3',
+        'kaito:num_requests_waiting{group="1"} 2',
+        'kaito:batch_occupancy{group="0"} 0.5',
+        'kaito:batch_occupancy{group="1"} 0.7',
+        "kaito:kv_page_size 16",
+        "kaito:something_else 42",
+    ])
+    vals = parse_load_metrics(text)
+    assert vals["waiting"] == 5.0
+    assert vals["occupancy"] == pytest.approx(0.6)
+    assert vals["page_size"] == 16.0
+    assert "something_else" not in vals
+
+
+def test_parse_backend_arg_role_and_group():
+    b = _parse_backend_arg("http://p0:5000=prefill/g0")
+    assert (b.url, b.role, b.group) == ("http://p0:5000", "prefill", "g0")
+    b = _parse_backend_arg("http://d0:5000=decode")
+    assert (b.role, b.group) == ("decode", "")
+    b = _parse_backend_arg("http://plain:5000")
+    assert (b.role, b.group) == ("", "")
+
+
+# ---------------------------------------------------------------------------
+# the picker's scoring chain
+# ---------------------------------------------------------------------------
+
+def _completion_body(prompt, **extra):
+    return json.dumps({"prompt": prompt, **extra}).encode()
+
+
+def test_block_chars_override_scrape_fallback():
+    p = EndpointPicker(["http://a:1", "http://b:1"], block_chars=32)
+    assert p.block_chars == 32
+    p = EndpointPicker(["http://a:1", "http://b:1"])
+    assert p.block_chars == DEFAULT_BLOCK_CHARS
+    # scraped engine page size (tokens) wins over the static default
+    p.backends[0].load.page_size = 32
+    assert p.block_chars == 32 * 4
+
+
+def test_candidates_prefer_affinity_owner_then_dead_last():
+    p = EndpointPicker(["http://a:1", "http://b:1"], block_chars=8)
+    prompt = "affinity-prompt " * 4
+    p.index.record(prefix_blocks(prompt, 8), "http://b:1")
+    ctx = p.make_ctx("POST", "/v1/completions", _completion_body(prompt))
+    assert ctx.matched.get("http://b:1")
+    order = [b.url for b in p.candidates("POST", "/v1/completions", ctx)]
+    assert order[0] == "http://b:1"
+    # a cooling-down backend is still yielded, but only as last resort
+    p.backends[1].down_until = time.monotonic() + 60
+    ctx = p.make_ctx("POST", "/v1/completions", _completion_body(prompt))
+    order = [b.url for b in p.candidates("POST", "/v1/completions", ctx)]
+    assert order == ["http://a:1", "http://b:1"]
+
+
+def test_saturated_or_tripped_backend_earns_no_affinity():
+    p = EndpointPicker(["http://a:1", "http://b:1"], block_chars=8)
+    prompt = "hot shared prefix! " * 4
+    p.index.record(prefix_blocks(prompt, 8), "http://a:1")
+    ctx = p.make_ctx("POST", "/v1/completions", _completion_body(prompt))
+    a, b = p.backends
+    assert p._score(a, ctx) > p._score(b, ctx)
+    # hysteresis saturation: affinity term zeroed
+    a.saturated = True
+    assert p._score(a, ctx) == pytest.approx(p._score(b, ctx))
+    a.saturated = False
+    # breaker not closed (half-open after consecutive failures): same
+    a.failures = BREAKER_THRESHOLD
+    assert a.state == "half-open"
+    assert p._score(a, ctx) == pytest.approx(p._score(b, ctx))
+    a.failures = 0
+    assert p._score(a, ctx) > p._score(b, ctx)
+    # saturation moves real load too: the picker routes AWAY
+    a.load.occupancy = 0.95
+    update_saturation(a)
+    ctx = p.make_ctx("POST", "/v1/completions", _completion_body(prompt))
+    order = [x.url for x in p.candidates("POST", "/v1/completions", ctx)]
+    assert order[0] == "http://b:1"
+
+
+def test_pd_filter_and_kv_locality_steer_decode_to_group():
+    from kaito_tpu.controllers.multiroleinference import \
+        default_pd_plugins_config
+
+    p = EndpointPicker(
+        [Backend("http://p0:1", role="prefill", group="g0"),
+         Backend("http://d0:1", role="decode", group="g0"),
+         Backend("http://d1:1", role="decode", group="g1")],
+        plugins_config=default_pd_plugins_config())
+    # the MRI chain has no affinity scorer: pd-filter + locality + queue
+    assert [t for t, _ in p.plugins] == [
+        "pd-filter", "kv-locality-scorer", "queue-depth-scorer"]
+    body = _completion_body("x", kv_transfer={"source_url": "http://p0:1"})
+    ctx = p.make_ctx("POST", "/v1/completions", body)
+    assert ctx.want_role == "decode" and ctx.kv_source == "http://p0:1"
+    order = [b.url for b in p.candidates("POST", "/v1/completions", ctx)]
+    # prefill replica filtered out; same-group decode replica first
+    assert order == ["http://d0:1", "http://d1:1"]
+    p.note_response(p.backends[1], ctx, 200)
+    assert p.m_pd_steered.value() == 1.0
+    # /pd/prefill steers to the prefill role
+    ctx = p.make_ctx("POST", "/pd/prefill", _completion_body("x"))
+    assert ctx.want_role == "prefill"
+    order = [b.url for b in p.candidates("POST", "/pd/prefill", ctx)]
+    assert order == ["http://p0:1"]
+
+
+def test_note_response_feeds_index_and_counters():
+    p = EndpointPicker(["http://a:1", "http://b:1"], block_chars=8)
+    prompt = "learned prefix 0123" * 2
+    ctx = p.make_ctx("POST", "/v1/completions", _completion_body(prompt))
+    assert ctx.matched == {}
+    p.note_response(p.backends[0], ctx, 200)
+    assert p.m_affinity_misses.value() == 1.0
+    # the next identical prompt now matches the serving backend
+    ctx = p.make_ctx("POST", "/v1/completions", _completion_body(prompt))
+    assert ctx.matched.get("http://a:1") == len(ctx.blocks) > 0
+    p.note_response(p.backends[0], ctx, 200)
+    assert p.m_affinity_hits.value() == 1.0
+    # 5xx responses do NOT claim ownership (the engine likely dropped it)
+    ctx2 = p.make_ctx("POST", "/v1/completions",
+                      _completion_body("other prompt ..!" * 2))
+    p.note_response(p.backends[1], ctx2, 500)
+    ctx2 = p.make_ctx("POST", "/v1/completions",
+                      _completion_body("other prompt ..!" * 2))
+    assert ctx2.matched == {}
+
+
+def test_epp_metrics_exposition_is_well_formed():
+    from tests.test_metrics_format import _check_histograms, _parse
+
+    p = EndpointPicker(["http://a:1", "http://b:1"], block_chars=8)
+    ctx = p.make_ctx("POST", "/v1/completions",
+                     _completion_body("expose me " * 3))
+    p.note_response(p.backends[0], ctx, 200)
+    p.upstream_latency.observe(0.01, backend="http://a:1")
+    p.m_forwarded.inc(backend="http://a:1")
+    samples = _parse(p.registry.expose())
+    names = {n for n, _, _ in samples}
+    # the picker's own series ride next to the shared transport families
+    assert {"kaito:epp_picks_total", "kaito:epp_affinity_misses_total",
+            "kaito:epp_backend_saturated", "kaito:epp_affinity_index_size",
+            "kaito:router_requests_forwarded_total",
+            "kaito:router_backend_breaker_state"} <= names
+    _check_histograms(samples)
+    by_line = {(n, lbl): v for n, lbl, v in samples}
+    assert by_line[("kaito:epp_picks_total",
+                    '{backend="http://a:1"}')] == 1.0
+    assert by_line[("kaito:epp_affinity_index_size", "")] > 0
+
+
+# ---------------------------------------------------------------------------
+# controllers: the pool's extensionRef resolves to a rendered workload
+# ---------------------------------------------------------------------------
+
+def _drive(mgr, cloud, n=10):
+    for _ in range(n):
+        mgr.resync()
+        cloud.tick()
+
+
+def _backend_args(dep):
+    cmd = dep.spec["template"]["spec"]["containers"][0]["command"]
+    return [cmd[i + 1] for i, a in enumerate(cmd) if a == "--backend"]
+
+
+def test_inferenceset_extension_ref_resolves_to_epp_workload():
+    from kaito_tpu.api import (InferenceSet, InferenceSetSpec, InferenceSpec,
+                               ObjectMeta, ResourceSpec)
+    from kaito_tpu.api.inferenceset import WorkspaceTemplate
+    from kaito_tpu.controllers.manager import Manager
+    from kaito_tpu.controllers.runtime import update_with_retry
+    from kaito_tpu.provision import FakeCloud
+
+    mgr = Manager(feature_gates="gatewayAPIInferenceExtension=true")
+    cloud = FakeCloud(mgr.store)
+    mgr.store.create(InferenceSet(
+        ObjectMeta(name="fleet"),
+        InferenceSetSpec(replicas=2, template=WorkspaceTemplate(
+            resource=ResourceSpec(instance_type="ct5lp-hightpu-1t"),
+            inference=InferenceSpec(preset="phi-4-mini-instruct")))))
+    _drive(mgr, cloud)
+    pool = mgr.store.get("InferencePool", "default", "fleet-pool")
+    ref = pool.spec["extensionRef"]["name"]
+    assert ref == "fleet-epp"
+    dep = mgr.store.get("Deployment", "default", ref)
+    assert mgr.store.get("Service", "default", ref)
+    assert len(_backend_args(dep)) == 2
+    assert dep.spec["template"]["spec"]["containers"][0]["command"][:3] == \
+        ["python", "-m", "kaito_tpu.runtime.epp"]
+
+    # scale-up refreshes the picker's --backend args next reconcile
+    def scale(o):
+        o.spec.replicas = 3
+    update_with_retry(mgr.store, "InferenceSet", "default", "fleet", scale)
+    _drive(mgr, cloud)
+    dep = mgr.store.get("Deployment", "default", ref)
+    assert len(_backend_args(dep)) == 3
+
+
+def test_mri_extension_ref_resolves_to_pd_aware_epp():
+    from kaito_tpu.api import MultiRoleInference, ObjectMeta
+    from kaito_tpu.api.multiroleinference import (MRIModelSpec,
+                                                  MultiRoleInferenceSpec,
+                                                  RoleSpec)
+    from kaito_tpu.controllers.manager import Manager
+    from kaito_tpu.provision import FakeCloud
+
+    mgr = Manager(feature_gates="enableMultiRoleInferenceController=true,"
+                                "gatewayAPIInferenceExtension=true")
+    cloud = FakeCloud(mgr.store)
+    mgr.store.create(MultiRoleInference(
+        ObjectMeta(name="pd"),
+        MultiRoleInferenceSpec(
+            model=MRIModelSpec(name="phi-4-mini-instruct"),
+            roles=[RoleSpec(type="prefill", replicas=1,
+                            instance_type="ct5lp-hightpu-1t"),
+                   RoleSpec(type="decode", replicas=2,
+                            instance_type="ct5lp-hightpu-1t")])))
+    _drive(mgr, cloud, 12)
+    pool = mgr.store.get("InferencePool", "default", "pd-pool")
+    ref = pool.spec["extensionRef"]["name"]
+    assert ref == "pd-epp"
+    dep = mgr.store.get("Deployment", "default", ref)
+    assert mgr.store.get("Service", "default", ref)
+    specs = _backend_args(dep)
+    assert len(specs) == 3
+    assert sum("=prefill/" in s for s in specs) == 1
+    assert sum("=decode/" in s for s in specs) == 2
+    # the rendered chain honors the MRI eppPluginsConfig
+    cmd = dep.spec["template"]["spec"]["containers"][0]["command"]
+    chain = json.loads(cmd[cmd.index("--plugins-config") + 1])
+    assert {"pd-filter", "kv-locality-scorer"} <= {
+        pl["type"] for pl in chain["plugins"]}
+    assert chain == pool.spec["eppPluginsConfig"]
+
+
+# ---------------------------------------------------------------------------
+# e2e: the PD chain routes a staged-KV decode through the picker
+# ---------------------------------------------------------------------------
+
+def _post(url, path, body, timeout=240.0):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    return json.loads(urllib.request.urlopen(req, timeout=timeout).read())
+
+
+def test_pd_epp_steers_staged_kv_decode_to_prefill_group():
+    """MRI sim leg: prefill + two decode replicas behind the picker's
+    PD plugin chain; the decode request carrying the staged-KV handle
+    lands on the prefill-colocated replica group and still matches the
+    monolithic greedy output."""
+    import threading
+
+    from kaito_tpu.controllers.multiroleinference import \
+        default_pd_plugins_config
+    from kaito_tpu.engine.config import EngineConfig
+    from kaito_tpu.engine.engine import InferenceEngine
+    from kaito_tpu.engine.server import make_server
+    from tests.helpers.dp_cluster import serve_front
+
+    def boot():
+        cfg = EngineConfig(model="tiny-llama-test", max_model_len=256,
+                           page_size=16, max_num_seqs=2, dtype="float32",
+                           kv_dtype="float32", prefill_buckets=(64, 128),
+                           seed=0, pd_enabled=True,
+                           pd_source_allowlist="http://127.0.0.1:")
+        eng = InferenceEngine(cfg)
+        eng.start()
+        srv = make_server(eng, cfg, host="127.0.0.1", port=0)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return eng, srv, f"http://127.0.0.1:{srv.server_address[1]}"
+
+    pre_eng, pre_srv, pre_url = boot()
+    d0_eng, d0_srv, d0_url = boot()          # same group as prefill
+    d1_eng, d1_srv, d1_url = boot()          # remote group
+    picker = EndpointPicker(
+        [Backend(pre_url, role="prefill", group="g0"),
+         Backend(d0_url, role="decode", group="g0"),
+         Backend(d1_url, role="decode", group="g1")],
+        plugins_config=default_pd_plugins_config())
+    try:
+        with serve_front(picker) as front:
+            prompt = "multi role inference"
+            mono = _post(d1_url, "/v1/completions", {
+                "prompt": prompt, "max_tokens": 6, "temperature": 0.0})
+            # prefill goes through the picker too: pd-filter keeps the
+            # prefill role only
+            pre = _post(front, "/pd/prefill",
+                        {"prompt": prompt, "temperature": 0.0})
+            out = _post(front, "/v1/completions", {
+                "prompt": prompt, "max_tokens": 6, "temperature": 0.0,
+                "kv_transfer": {"source_url": pre_url,
+                                "req_id": pre["req_id"],
+                                "prompt_tokens": pre["prompt_tokens"],
+                                "first_token": pre["first_token"],
+                                "force": True}})
+            assert out["choices"][0]["text"] == mono["choices"][0]["text"]
+            # the kv-locality scorer sent decode to the prefill's group
+            assert picker.m_pd_steered.value() == 1.0
+            assert picker.stats()[d0_url]["served"] >= 1
+            assert d0_eng.counters["pd_device_handoffs_total"] == 1
+            assert d1_eng.counters["pd_device_handoffs_total"] == 0
+            # prefill request landed on the prefill replica
+            assert picker.stats()[pre_url]["served"] >= 1
+    finally:
+        for srv in (pre_srv, d0_srv, d1_srv):
+            srv.shutdown()
+        for eng in (pre_eng, d0_eng, d1_eng):
+            eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# e2e: affinity beats round robin over real process boundaries
+# ---------------------------------------------------------------------------
+
+def _engine_counter(url, name):
+    text = urllib.request.urlopen(url + "/metrics", timeout=10).read()
+    for line in text.decode("utf-8", "replace").splitlines():
+        if line.startswith(name + " ") or line.startswith(name + "{"):
+            return float(line.rsplit(" ", 1)[1])
+    return 0.0
+
+
+def _ttft(front_url, prompt):
+    """Wall time to the FIRST streamed body byte through a front."""
+    import http.client
+
+    u = urllib.parse.urlsplit(front_url)
+    conn = http.client.HTTPConnection(u.hostname, u.port, timeout=240)
+    payload = json.dumps({"prompt": prompt, "max_tokens": 2,
+                          "temperature": 0.0, "stream": True}).encode()
+    t0 = time.monotonic()
+    conn.request("POST", "/v1/completions", body=payload,
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    first = resp.read1(1) if hasattr(resp, "read1") else resp.read(1)
+    ttft = time.monotonic() - t0
+    assert first, "stream ended with no body"
+    resp.read()
+    conn.close()
+    return ttft
+
+
+def _rand_prefix(seed, chars):
+    import random
+
+    rng = random.Random(seed)
+    return "".join(rng.choice("abcdefghijklmnopqrstuvwxyz ")
+                   for _ in range(chars))
+
+
+def _run_leg(front_url, urls, seed_base, groups=6):
+    """``groups`` prefix groups of (cold, warm) requests; returns
+    (prefix-cache hits across the pool, warm-request TTFTs)."""
+    hits0 = sum(_engine_counter(u, "kaito:prefix_cache_hits_total")
+                for u in urls)
+    ttfts = []
+    for g in range(groups):
+        # 384 chars = 6 engine pages (page 64 incl BOS) = 6 picker
+        # blocks (DEFAULT_BLOCK_CHARS 64): the whole prefix is reusable
+        prefix = _rand_prefix(seed_base + g, 384)
+        _post(front_url, "/v1/completions",
+              {"prompt": prefix + f" cold tail {g}", "max_tokens": 2,
+               "temperature": 0.0})
+        ttfts.append(_ttft(front_url, prefix + f" warm tail {g}"))
+    hits = sum(_engine_counter(u, "kaito:prefix_cache_hits_total")
+               for u in urls) - hits0
+    return hits, ttfts
+
+
+def test_epp_beats_round_robin_on_prefix_hit_rate_and_ttft():
+    """The acceptance e2e: identical repeated-prefix load over the SAME
+    two engine processes — the round-robin front splits each prefix
+    group across replicas (warm requests miss), the picker colocates
+    them (warm requests hit), so the picker shows strictly more
+    prefix-cache hits and lower mean warm TTFT."""
+    from kaito_tpu.runtime.dp_router import DPRouter
+    from tests.helpers.dp_cluster import boot_backends, serve_front
+
+    with boot_backends(2, extra_args=["--max-model-len", "512"]) as urls:
+        # compile every kernel the timed legs will hit, on BOTH
+        # replicas: cold 512-bucket prefill, the short remainder
+        # bucket, decode — and the CACHED-prefill variant (same long
+        # prompt twice so the second run restores pages)
+        for i, u in enumerate(urls):
+            for n in (420, 420, 40):
+                _post(u, "/v1/completions",
+                      {"prompt": _rand_prefix(i * 7 + n, n),
+                       "max_tokens": 2, "temperature": 0.0})
+
+        rr = DPRouter(urls)
+        with serve_front(rr) as front:
+            rr_hits, rr_ttfts = _run_leg(front, urls, seed_base=1000)
+
+        picker = EndpointPicker(urls)
+        with serve_front(picker) as front:
+            epp_hits, epp_ttfts = _run_leg(front, urls, seed_base=2000)
+
+        # strict round robin alternates within every group: ~zero warm
+        # hits; the picker's affinity makes EVERY warm request a hit
+        assert epp_hits > rr_hits, (epp_hits, rr_hits)
+        assert epp_hits >= len(epp_ttfts)
+        assert picker.m_affinity_hits.value() >= len(epp_ttfts)
+        mean = lambda xs: sum(xs) / len(xs)  # noqa: E731
+        assert mean(epp_ttfts) < mean(rr_ttfts), (epp_ttfts, rr_ttfts)
