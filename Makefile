@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: all native unit-test unit-test-fast unit-test-slow engine-test rag-test chaos kvq obs slo bench serve manager epp clean
+.PHONY: all native unit-test unit-test-fast unit-test-slow engine-test rag-test chaos kvq obs slo spec bench serve manager epp clean
 
 all: native
 
@@ -52,6 +52,12 @@ obs:
 # SLO watchdog suite alone (docs/observability.md "Control plane")
 slo:
 	$(PYTHON) -m pytest tests/test_slo.py -q
+
+# speculative-decoding suite (docs/speculative.md): n-gram + draft
+# model paths — rejection sampler properties, adaptive-depth
+# controller, real-checkpoint greedy equivalence, plumbing
+spec:
+	$(PYTHON) -m pytest tests/test_speculative.py tests/test_spec_draft.py -q
 
 bench:
 	$(PYTHON) bench.py

@@ -305,6 +305,53 @@ def orchestrate(args):
                 res.get("error", "spec serve failed"))
         save_partial()
 
+    # --- phase: serving with DRAFT-MODEL speculation ON — greedy and
+    # sampled legs (self-draft: acceptance is an upper bound, but the
+    # whole propose/verify/accept machinery including rejection
+    # sampling is the code under test; docs/speculative.md).  Paired
+    # with the spec-off serve row + the sampled baseline below, this
+    # fills the draft on/off x greedy/sampled matrix ---
+    if not args.skip_server_bench and not args.skip_spec_bench \
+            and remaining() > 120:
+        res = run_phase("serve", passthru + ["--spec-draft", "self"],
+                        min(remaining(), 650.0))
+        if "server_tok_s" in res:
+            merged["spec_draft_server_tok_s"] = res["server_tok_s"]
+            for k in ("server_batch", "spec_accept_rate",
+                      "spec_mean_depth", "mfu_pct", "hbm_roofline_pct"):
+                if k in res:
+                    merged[f"spec_draft_{k}"] = res[k]
+        else:
+            merged.setdefault("errors", []).append(
+                res.get("error", "spec-draft serve failed"))
+        save_partial()
+    if not args.skip_server_bench and not args.skip_spec_bench \
+            and remaining() > 120:
+        res = run_phase("serve",
+                        passthru + ["--spec-temp", "0.8"],
+                        min(remaining(), 650.0))
+        if "server_tok_s" in res:
+            merged["sampled_server_tok_s"] = res["server_tok_s"]
+        else:
+            merged.setdefault("errors", []).append(
+                res.get("error", "sampled serve failed"))
+        save_partial()
+    if not args.skip_server_bench and not args.skip_spec_bench \
+            and remaining() > 120:
+        res = run_phase("serve",
+                        passthru + ["--spec-draft", "self",
+                                    "--spec-temp", "0.8"],
+                        min(remaining(), 650.0))
+        if "server_tok_s" in res:
+            merged["spec_draft_sampled_server_tok_s"] = res["server_tok_s"]
+            for k in ("spec_accept_rate", "spec_mean_depth"):
+                if k in res:
+                    merged[f"spec_draft_sampled_{k}"] = res[k]
+        else:
+            merged.setdefault("errors", []).append(
+                res.get("error", "spec-draft sampled serve failed"))
+        save_partial()
+
     # --- phase: prefix-hit TTFT (cold vs warm shared-prefix prompt;
     # the row EPP affinity routing banks on, docs/routing.md) ---
     if not args.skip_prefix_bench and remaining() > 90:
@@ -432,7 +479,8 @@ def phase_probe():
 
 
 def bench_serving_path(model_name: str, on_tpu: bool, quant: str = "",
-                       spec_ngram: int = 0):
+                       spec_ngram: int = 0, spec_draft: str = "",
+                       spec_temp: float = 0.0):
     """Serving-path benchmark: the REAL engine (scheduler, paged KV,
     chunked prefill interleave, continuous admission) under sustained
     load — the regime the reference's vLLM benchmark sweeps
@@ -454,7 +502,7 @@ def bench_serving_path(model_name: str, on_tpu: bool, quant: str = "",
         seq_ladder = (96, 64, 48)
     else:
         seq_ladder = (4,)
-    if spec_ngram:
+    if spec_ngram or spec_draft:
         # speculation only engages at/below speculative_max_batch: the
         # spec on/off row measures the low-batch latency regime
         seq_ladder = (8,) if on_tpu else (4,)
@@ -462,7 +510,9 @@ def bench_serving_path(model_name: str, on_tpu: bool, quant: str = "",
     for i, max_seqs in enumerate(seq_ladder):
         try:
             return _bench_serving_once(model_name, on_tpu, quant, max_seqs,
-                                       spec_ngram=spec_ngram)
+                                       spec_ngram=spec_ngram,
+                                       spec_draft=spec_draft,
+                                       spec_temp=spec_temp)
         except Exception as e:
             msg = f"{type(e).__name__}: {str(e)[:300]}"
             retryable = ("RESOURCE_EXHAUSTED" in str(e)
@@ -487,7 +537,9 @@ class _ServingStall(RuntimeError):
 
 
 def _bench_serving_once(model_name: str, on_tpu: bool, quant: str,
-                        max_seqs: int, spec_ngram: int = 0) -> dict:
+                        max_seqs: int, spec_ngram: int = 0,
+                        spec_draft: str = "",
+                        spec_temp: float = 0.0) -> dict:
     from kaito_tpu.engine.config import EngineConfig
     from kaito_tpu.engine.engine import InferenceEngine, SamplingParams
 
@@ -515,6 +567,7 @@ def _bench_serving_once(model_name: str, on_tpu: bool, quant: str,
                        prefill_buckets=buckets, enable_prefix_caching=False,
                        quantization=quant, disable_rate_limit=True,
                        speculative_ngram=spec_ngram,
+                       speculative_draft=spec_draft,
                        max_queue_len=100000)
     eng = InferenceEngine(cfg)
     eng.start()
@@ -532,7 +585,8 @@ def _bench_serving_once(model_name: str, on_tpu: bool, quant: str,
                 return
             req = eng.submit(
                 crng.randint(1, min(vocab, 255), (prompt_len,)).tolist(),
-                SamplingParams(max_tokens=out_toks, temperature=0.0,
+                SamplingParams(max_tokens=out_toks,
+                               temperature=spec_temp,
                                ignore_eos=True))
             for _ in req.stream():
                 pass
@@ -630,6 +684,21 @@ def _bench_serving_once(model_name: str, on_tpu: bool, quant: str,
         out["spec_ngram"] = spec_ngram
         if proposed:
             out["spec_accept_rate"] = round(accepted / proposed, 3)
+    if spec_draft:
+        proposed = eng.counters.get("spec_draft_proposed_tokens_total", 0)
+        accepted = eng.counters.get("spec_draft_accepted_tokens_total", 0)
+        rows = eng.counters.get("spec_draft_rows_total", 0)
+        out["spec_draft"] = spec_draft
+        if spec_temp:
+            out["spec_temp"] = spec_temp
+        if proposed:
+            out["spec_accept_rate"] = round(accepted / proposed, 3)
+        if rows:
+            # mean REALIZED depth per drafting slot-round (after
+            # remaining-budget clipping and the controller's AIMD
+            # moves) — the lever the adaptive depth actually pulled,
+            # not the configured ceiling
+            out["spec_mean_depth"] = round(proposed / rows, 2)
     if ttfts:
         p50 = sorted(ttfts)[len(ttfts) // 2]
         log(f"[server] TTFT@{probe_len}in under half-load: "
@@ -896,8 +965,13 @@ def phase_serve(args):
     on_tpu = platform not in ("cpu",)
     model_name = args.model or ("phi-4-mini-instruct" if on_tpu
                                 else "tiny-llama-test")
+    spec_draft = args.spec_draft
+    if spec_draft == "self":
+        spec_draft = model_name
     res = bench_serving_path(model_name, on_tpu, quant=args.quant,
-                             spec_ngram=args.spec_ngram)
+                             spec_ngram=args.spec_ngram,
+                             spec_draft=spec_draft,
+                             spec_temp=args.spec_temp)
     print(json.dumps(res), flush=True)
 
 
@@ -1139,6 +1213,14 @@ def main():
                     help="cp phase: measure only the per-chip shard-"
                          "attention critical path (the cheap >=32k leg)")
     ap.add_argument("--skip-cp-bench", action="store_true")
+    ap.add_argument("--spec-draft", default="",
+                    help="draft preset for the speculative serve leg "
+                         "('self' = the benched model drafts for "
+                         "itself)")
+    ap.add_argument("--spec-temp", type=float, default=0.0,
+                    help="client sampling temperature for the serve "
+                         "phase (draft speculation keeps sampled "
+                         "traffic distribution-identical)")
     ap.add_argument("--spec-ngram", type=int, default=0,
                     help="serve phase: n-gram speculation window "
                          "(0 = off; the spec on/off ladder row)")

@@ -200,6 +200,13 @@ class WorkspaceReconciler(Reconciler):
         # through kv_bytes_per_token)
         kv_dtype = ws.metadata.annotations.get(
             "kaito-tpu.io/kv-cache-dtype", "")
+        # speculative-draft pairing fails the plan (PlanFailed
+        # condition + event) when the named draft is unknown or shares
+        # no tokenizer with the target — before any capacity is asked
+        # for (docs/speculative.md)
+        from kaito_tpu.models.registry import resolve_speculative_draft
+        resolve_speculative_draft(md, ws.metadata.annotations.get(
+            "kaito-tpu.io/speculative-draft", ""))
         # CP prefill auto-carve is evidence-gated (plan_parallelism
         # docstring: BENCH_r05 cp_speedup 0.68 < 1.0) — serve plans
         # only carve a sequence axis when the user opts in

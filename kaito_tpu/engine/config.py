@@ -69,6 +69,18 @@ class EngineConfig:
     speculative_ngram: int = 0
     speculative_min_match: int = 2
     speculative_max_batch: int = 8
+    # draft-model speculative decoding (docs/speculative.md): a small
+    # co-resident draft preset proposes up to speculative_draft_k
+    # tokens per slot, the target verifies the window in one forward,
+    # and Leviathan rejection sampling keeps sampled traffic
+    # distribution-identical (greedy stays bit-exact).  A per-slot
+    # accept-rate controller adapts the depth and falls back to the
+    # n-gram proposer (then plain decode) on sustained-poor acceptance.
+    # "" = off; the value names a catalog preset sharing the target's
+    # tokenizer (validated at load).
+    speculative_draft: str = ""
+    speculative_draft_k: int = 4
+    speculative_draft_weights_dir: str = ""   # "" = synthetic weights
     # serving-side knobs carried over from the reference wrapper surface
     port: int = 5000
     served_model_name: str = ""

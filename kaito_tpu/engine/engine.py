@@ -41,7 +41,9 @@ from kaito_tpu.engine.config import EngineConfig
 from kaito_tpu.engine.kv_cache import (KVCache, create_kv_cache,
                                        scale_bytes_per_page)
 from kaito_tpu.engine.model import TransformerLM
-from kaito_tpu.engine.sampler import SamplingState, chosen_logprob, sample
+from kaito_tpu.engine.sampler import (SamplingState, chosen_logprob,
+                                      sample, spec_verify_sample)
+from kaito_tpu.engine.spec import NgramIndex
 from kaito_tpu.engine.tokenizer import load_tokenizer
 from kaito_tpu.estimator.estimator import PER_CHIP_OVERHEAD_BYTES, HBM_UTILIZATION
 from kaito_tpu.models.metadata import ModelMetadata
@@ -318,6 +320,19 @@ class InferenceEngine:
                     sum(x.nbytes for x in jax.tree.leaves(self.params))
                     / 2**30)
 
+        # draft-model speculation (docs/speculative.md): the draft and
+        # its private KV pool come up BEFORE target-pool sizing so the
+        # derived page count reads the HBM actually left over
+        self.spec_draft = None
+        self.spec_ctl = None
+        self._ngram_idx: dict[int, NgramIndex] = {}
+        if cfg.speculative_draft:
+            from kaito_tpu.engine.spec import DepthController, DraftRunner
+
+            self.spec_draft = DraftRunner(self)
+            self.spec_ctl = DepthController(cfg.max_num_seqs,
+                                            cfg.speculative_draft_k)
+
         self.sizing_report: dict = {}
         num_pages = cfg.max_pages or self._derive_max_pages()
         num_pages = max(num_pages, cfg.max_num_seqs * self.pages_per_seq // 4 + 2)
@@ -442,6 +457,12 @@ class InferenceEngine:
             "spec_steps_total": 0,
             "spec_proposed_tokens_total": 0,
             "spec_accepted_tokens_total": 0,
+            # draft-model speculation (the three above stay the n-gram
+            # proposer's; /metrics labels them mode="ngram"|"draft")
+            "spec_draft_steps_total": 0,
+            "spec_draft_rows_total": 0,
+            "spec_draft_proposed_tokens_total": 0,
+            "spec_draft_accepted_tokens_total": 0,
             "pd_device_handoffs_total": 0,
             # failure-domain isolation
             "requests_failed_total": 0,       # request-scoped failures
@@ -1393,6 +1414,14 @@ class InferenceEngine:
         if sp.temperature > 0.0 or sp.top_k > 0 or sp.top_p < 1.0 \
                 or sp.min_p > 0.0 or sp.has_penalties:
             self.sampling = self.sampling.reset_slot(slot_idx)
+        # speculation state is per-slot: draft pages/position return to
+        # the draft pool, the depth controller restarts, and the cached
+        # n-gram index drops (rebuilt from resume_tokens on re-admission)
+        if self.spec_draft is not None:
+            self.spec_draft.release_slot(slot_idx)
+        if self.spec_ctl is not None:
+            self.spec_ctl.reset(slot_idx)
+        self._ngram_idx.pop(slot_idx, None)
         slot.request = None
         slot.pages = []
         slot.prefilling = False
@@ -2478,44 +2507,53 @@ class InferenceEngine:
 
     def _spec_ok(self) -> bool:
         """Speculate only when it is exact and cheap: engine opted in,
-        no PP executor (the verify path drives the model directly),
-        every active slot greedy (acceptance is deterministic argmax
-        equality), and the batch small enough that the on-device
-        [B, W, V] verify logits stay negligible."""
+        no PP executor (the verify path drives the model directly), and
+        the batch small enough that the on-device [B, W, V] verify
+        logits stay negligible.  The n-gram-only path additionally
+        requires every active slot greedy (acceptance is deterministic
+        argmax equality); a draft-configured engine speculates for
+        greedy AND pure-temperature sampling (Leviathan rejection
+        sampling is distribution-preserving), but top-k/top-p/min-p
+        masks and penalties modify the target distribution mid-window
+        and keep the plain path."""
         cfg = self.cfg
-        if cfg.speculative_ngram <= 0 or self.pp_exec is not None:
+        draft = self.spec_draft is not None
+        if (cfg.speculative_ngram <= 0 and not draft) \
+                or self.pp_exec is not None:
             return False
         n_active = 0
         for i, s in enumerate(self.slots):
             if s.request is None or not self.active[i]:
                 continue
             n_active += 1
-            if s.request.params.temperature > 0.0 \
-                    or s.request.params.has_penalties \
-                    or s.request.aborted:
+            p = s.request.params
+            if p.has_penalties or s.request.aborted:
                 return False
+            if p.temperature > 0.0:
+                if not draft:
+                    return False
+                if p.top_k > 0 or p.top_p < 1.0 or p.min_p > 0.0:
+                    return False
         return 0 < n_active <= cfg.speculative_max_batch
 
-    def _propose(self, req: Request) -> list[int]:
+    def _propose(self, slot_idx: int, req: Request) -> list[int]:
         """Prompt-lookup proposal: find the last earlier occurrence of
         the sequence's trailing n-gram and propose the tokens that
-        followed it (vLLM's ngram speculator recipe)."""
+        followed it (vLLM's ngram speculator recipe).
+
+        The lookup structure is a per-request last-occurrence index
+        (spec.NgramIndex), built once from resume_tokens on the slot's
+        first proposal and append-updated by ``_emit`` — not a rescan
+        of the trailing context every step."""
         k = self.cfg.speculative_min_match
         K = self.cfg.speculative_ngram
-        ctx_list = req.resume_tokens()
-        if len(ctx_list) <= k:
+        if K <= 0:
             return []
-        ctx = np.asarray(ctx_list[-4096:], np.int32)   # bound the scan
-        tail = ctx[-k:]
-        # vectorized: candidate starts where the first tail element
-        # matches, newest first; full k-gram compare only on candidates
-        starts = np.flatnonzero(ctx[: len(ctx) - k] == tail[0])
-        for i in starts[::-1]:
-            if np.array_equal(ctx[i:i + k], tail):
-                out = ctx[i + k: i + k + K]
-                if len(out):
-                    return [int(t) for t in out]
-        return []
+        idx = self._ngram_idx.get(slot_idx)
+        if idx is None or idx.k != k:
+            idx = NgramIndex(k, req.resume_tokens())
+            self._ngram_idx[slot_idx] = idx
+        return idx.propose(K)
 
     def _verify_fn(self, W: int):
         key = ("verify", W)
@@ -2534,6 +2572,42 @@ class InferenceEngine:
             fn = self._prefill_fns[key] = verify
         return fn
 
+    def _verify_accept_fn(self, W: int):
+        """Fused verify + accept for the draft path: ONE program runs
+        the [B, W] target forward AND the Leviathan rejection sampler —
+        the [B, W, V] logits never leave the device (the greedy n-gram
+        path keeps the leaner argmax-only ``_verify_fn``)."""
+        key = ("verify_accept", W)
+        fn = self._prefill_fns.get(key)
+        if fn is None:
+            model = self.model
+
+            @partial(jax.jit, donate_argnums=(1,))
+            def verify_accept(params, cache, tokens, true_lens,
+                              page_tables, start_pos, adapter_ids,
+                              draft_logits, prop_len, temperature,
+                              onehot_q, keys):
+                cache, logits = model.verify_window_logits(
+                    params, cache, tokens, true_lens, page_tables,
+                    start_pos, adapter_ids=adapter_ids)
+                out, n_emit, lps, new_keys = spec_verify_sample(
+                    logits, draft_logits, tokens[:, 1:], prop_len,
+                    temperature, onehot_q, keys)
+                return cache, out, n_emit, lps, new_keys
+
+            fn = self._prefill_fns[key] = verify_accept
+        return fn
+
+    @property
+    def spec_depth(self) -> float:
+        """Mean adaptive speculation depth over active slots (0 when
+        draft speculation is off, idle, or fully fallen back)."""
+        if self.spec_ctl is None:
+            return 0.0
+        idxs = [i for i, s in enumerate(self.slots)
+                if s.request is not None and self.active[i]]
+        return self.spec_ctl.mean_depth(idxs)
+
     def _decode_speculative(self) -> int:
         """One windowed verify dispatch over a COMPACT batch of the
         speculating slots (padded to speculative_max_batch so one
@@ -2545,6 +2619,8 @@ class InferenceEngine:
         proposals anywhere, or the page pool cannot fund the window
         without preempting) — the caller falls through to the normal
         decode paths."""
+        if self.spec_draft is not None:
+            return self._decode_speculative_draft()
         W = self.cfg.speculative_ngram + 1
         rows: list[int] = []          # compact row -> slot index
         proposals: list[list[int]] = []
@@ -2552,7 +2628,7 @@ class InferenceEngine:
         for i, slot in enumerate(self.slots):
             if slot.request is None or not self.active[i]:
                 continue
-            p = self._propose(slot.request)
+            p = self._propose(i, slot.request)
             # never speculate past the budget: tokens beyond remaining
             # would be emitted-and-truncated work
             p = p[: max(0, slot.remaining - 1)]
@@ -2610,6 +2686,166 @@ class InferenceEngine:
             max_emitted = max(max_emitted, len(emitted))
         return max_emitted
 
+    def _decode_speculative_draft(self) -> int:
+        """Draft-model speculative step (docs/speculative.md): every
+        active slot becomes one row of a single [B, W] verify window.
+        Draft-mode rows carry an autoregressive proposal from the
+        co-resident draft at the controller's per-slot depth; fallback
+        rows carry an n-gram proposal (one-hot q); rows with nothing to
+        propose ride along as a plain one-token step (prop_len = 0 —
+        the worst case costs exactly one verify step).  Acceptance is
+        Leviathan rejection sampling fused into the verify program, so
+        sampled slots speculate too and greedy stays bit-exact.
+
+        Returns the max tokens any slot emitted, or 0 to fall through
+        to the plain fused decode (all controllers fallen back with no
+        n-gram hits — the bottom rung of the fallback ladder)."""
+        cfg = self.cfg
+        runner = self.spec_draft
+        ctl = self.spec_ctl
+        W = max(cfg.speculative_draft_k, cfg.speculative_ngram) + 1
+        rows = [i for i, slot in enumerate(self.slots)
+                if slot.request is not None and self.active[i]]
+        if not rows:
+            return 0
+        B = cfg.speculative_max_batch
+
+        # plan: per-slot draft depth (0 = this round proposes nothing
+        # with the draft; the slot's draft KV may still be catching up)
+        depths: dict[int, int] = {}
+        for i in rows:
+            slot = self.slots[i]
+            depth = 0
+            if ctl.mode(i) == "draft":
+                depth = min(ctl.depth(i), max(0, slot.remaining - 1),
+                            cfg.speculative_draft_k)
+                if depth > 0:
+                    pos = slot.position
+                    ok = runner.sync(i, pos, slot.request.resume_tokens) \
+                        and runner.ensure_pages(i, pos + depth)
+                    if not ok:
+                        depth = 0     # mid-catch-up: plain step this round
+            depths[i] = depth
+        k_exec = max([depths[i] for i in rows], default=0)
+        if k_exec > 0:
+            k_exec = 1 << (k_exec - 1).bit_length()   # pow2 program buckets
+
+        # n-gram fallback proposals (controller-demoted slots)
+        proposals: dict[int, list[int]] = {}
+        any_prop = k_exec > 0
+        for i in rows:
+            p: list[int] = []
+            if depths[i] == 0 and ctl.mode(i) == "ngram" \
+                    and cfg.speculative_ngram > 0:
+                slot = self.slots[i]
+                p = self._propose(i, slot.request)
+                p = p[: max(0, min(slot.remaining - 1, W - 1))]
+                ctl.note_fallback_round(i)
+            proposals[i] = p
+            any_prop = any_prop or bool(p)
+        if not any_prop:
+            return 0              # plain decode: nothing to verify
+        if not self._lookahead_fits(W):
+            # the speculative-page invariant: lookahead pages must never
+            # preempt a running sequence (draft pages are pool-private
+            # and can't either — spec.DraftRunner)
+            return 0
+        self._ensure_decode_pages(W)
+
+        slot_map = np.full((B,), -1, np.int64)
+        toks = np.zeros((B, W), np.int32)
+        tl = np.zeros((B,), np.int32)
+        sp = np.zeros((B,), np.int32)
+        tables = np.zeros((B, self.pages_per_seq), np.int32)
+        aids = np.zeros((B,), np.int32)
+        temps = np.zeros((B,), np.float32)
+        onehot = np.ones((B,), bool)
+        draft_rows = np.zeros((B,), bool)
+        last = np.zeros((B,), np.int32)
+        for r, i in enumerate(rows):
+            slot = self.slots[i]
+            slot_map[r] = i
+            sp[r] = slot.position
+            tables[r] = self.page_tables[i]
+            aids[r] = self.slot_adapters[i]
+            temps[r] = slot.request.params.temperature
+            last[r] = int(self.last_tokens[i])
+            draft_rows[r] = depths[i] > 0
+            # draft rows verify against the draft's real q; n-gram /
+            # empty rows are deterministic proposers (one-hot q)
+            onehot[r] = depths[i] <= 0
+
+        if k_exec > 0:
+            props, dlogits = runner.propose(
+                slot_map, last, sp, temps, draft_rows, k_exec)
+            if k_exec < W - 1:
+                dlogits = jnp.pad(
+                    dlogits, ((0, 0), (0, W - 1 - k_exec), (0, 0)))
+            for r, i in enumerate(rows):
+                if depths[i] > 0:
+                    proposals[i] = [int(t) for t in props[r, :depths[i]]]
+        else:
+            dlogits = jnp.zeros((B, W - 1, self.md.arch.vocab_size),
+                                jnp.float32)
+
+        prop_len = np.zeros((B,), np.int32)
+        for r, i in enumerate(rows):
+            window = [last[r]] + proposals[i]
+            toks[r, : len(window)] = window
+            tl[r] = len(window)
+            prop_len[r] = len(proposals[i])
+
+        keys = runner.gather_keys(slot_map)
+        cache, out, n_emit, lps, new_keys = self._verify_accept_fn(W)(
+            self.params, self.cache, jnp.asarray(toks), jnp.asarray(tl),
+            jnp.asarray(tables), jnp.asarray(sp), jnp.asarray(aids),
+            dlogits, jnp.asarray(prop_len), jnp.asarray(temps),
+            jnp.asarray(onehot), keys)
+        self.cache = cache
+        runner.scatter_keys(slot_map, new_keys)
+        out = np.asarray(out)
+        n_emit = np.asarray(n_emit)
+        lps = np.asarray(lps)
+        self.counters["decode_steps_total"] += 1
+        self.counters["spec_steps_total"] += 1
+        if k_exec > 0:
+            self.counters["spec_draft_steps_total"] += 1
+
+        max_emitted = 0
+        for r, i in enumerate(rows):
+            slot = self.slots[i]
+            if slot.request is None:
+                continue
+            p = proposals[i]
+            e = int(n_emit[r])
+            a = e - 1       # accepted proposal prefix
+            if depths[i] > 0:
+                self.counters["spec_draft_rows_total"] += 1
+                self.counters["spec_draft_proposed_tokens_total"] += len(p)
+                self.counters["spec_draft_accepted_tokens_total"] += a
+                ctl.observe(i, len(p), a)
+            elif p:
+                self.counters["spec_proposed_tokens_total"] += len(p)
+                self.counters["spec_accepted_tokens_total"] += a
+            want_lp = slot.request.params.logprobs
+            emitted = [int(t) for t in out[r, :e]]
+            for j, t in enumerate(emitted):
+                if slot.request is None:
+                    break        # retired mid-window (stop/budget/abort)
+                self.positions[i] += 1
+                slot.position += 1
+                self._emit(i, t,
+                           logprob=float(lps[r, j]) if want_lp else None)
+                self.last_tokens[i] = t
+            if slot.request is not None and depths[i] > 0:
+                # steady-state invariant: the draft KV's valid prefix
+                # equals the new position — the next round needs no
+                # catch-up (rejected-position writes get overwritten
+                # before anything can attend to them)
+                runner.commit(i, slot.position)
+            max_emitted = max(max_emitted, len(emitted))
+        return max_emitted
+
     def _stop_set(self, req: Request) -> set:
         stop_ids = set(req.params.stop_token_ids)
         eos = self.tokenizer.eos_token_id
@@ -2624,6 +2860,9 @@ class InferenceEngine:
         req = slot.request
         assert req is not None
         req.output_tokens.append(token)
+        ngram_idx = self._ngram_idx.get(slot_idx)
+        if ngram_idx is not None:
+            ngram_idx.append(token)
         if req.params.logprobs:
             req.output_logprobs.append(logprob)
         slot.remaining -= 1

@@ -343,6 +343,29 @@ class EngineMetrics:
             Gauge("kaito:engine_fatal_total",
                   "Engine-fatal failures (every in-flight request failed)", r,
                   fn=lambda: engine.counters.get("engine_fatal_total", 0))
+            # speculative decoding (docs/speculative.md): proposer-mode
+            # label splits the n-gram and draft-model paths so accept
+            # rate per mode is a direct PromQL ratio; kaito:spec_depth
+            # is the controller's mean adaptive depth across active
+            # slots (0 while in n-gram fallback / speculation off)
+            Gauge("kaito:spec_proposed_tokens_total",
+                  "Speculative tokens proposed", r, labels=("mode",),
+                  fn=lambda: {
+                      ("ngram",): engine.counters.get(
+                          "spec_proposed_tokens_total", 0),
+                      ("draft",): engine.counters.get(
+                          "spec_draft_proposed_tokens_total", 0)})
+            Gauge("kaito:spec_accepted_tokens_total",
+                  "Speculative tokens accepted by the target", r,
+                  labels=("mode",),
+                  fn=lambda: {
+                      ("ngram",): engine.counters.get(
+                          "spec_accepted_tokens_total", 0),
+                      ("draft",): engine.counters.get(
+                          "spec_draft_accepted_tokens_total", 0)})
+            Gauge("kaito:spec_depth",
+                  "Mean adaptive speculation depth over active slots", r,
+                  fn=lambda: getattr(engine, "spec_depth", 0.0))
             # live-calibrated break-even constants (0 until the first
             # observed transfer / prefill provides a sample)
             Gauge("kaito:pd_measured_net_bytes_s",

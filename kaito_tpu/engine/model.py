@@ -788,22 +788,22 @@ class TransformerLM:
             x, (true_lens - 1)[:, None, None].astype(jnp.int32), axis=1)[:, 0]
         return cache, self._logits(params, last), last
 
-    def verify_window(self, params, cache: KVCache, tokens, true_lens,
-                      page_tables, start_pos, adapter_ids=None):
-        """Speculative-decoding verification: run a small window of
-        proposed tokens (chunked-prefill machinery — paged history +
+    def verify_window_logits(self, params, cache: KVCache, tokens,
+                             true_lens, page_tables, start_pos,
+                             adapter_ids=None):
+        """Speculative-decoding verification forward: run a small window
+        of proposed tokens (chunked-prefill machinery — paged history +
         causal window attention, KV written in place) and return the
-        GREEDY next token and its model logprob at EVERY window
-        position.
+        full-precision logits at EVERY window position.
 
         tokens: [B, W] (= [last_emitted, proposal...], -pad);
         true_lens: [B] valid window tokens (0 skips a slot — its writes
         mask to the null page); start_pos: [B] absolute position of the
-        window start.  Returns (cache, targets [B, W] int32,
-        lps [B, W] f32) — the [B, W, V] logits never leave the device.
+        window start.  Returns (cache, logits [B, W, V] f32).  Callers
+        jit this together with their acceptance rule (greedy argmax or
+        ``sampler.spec_verify_sample``) so the [B, W, V] tensor never
+        leaves the device.
         """
-        from kaito_tpu.engine.sampler import chosen_logprob
-
         B, W = tokens.shape
         rel = jnp.broadcast_to(jnp.arange(W, dtype=jnp.int32), (B, W))
         positions = rel + start_pos[:, None]
@@ -814,6 +814,22 @@ class TransformerLM:
             active=None, start_pos=start_pos, adapter_ids=adapter_ids)
         x = self._norm(x, params, "final_norm")
         logits = self._logits(params, x).astype(jnp.float32)   # [B, W, V]
+        return cache, logits
+
+    def verify_window(self, params, cache: KVCache, tokens, true_lens,
+                      page_tables, start_pos, adapter_ids=None):
+        """Greedy verification (the n-gram speculative path): the
+        :meth:`verify_window_logits` forward reduced to the GREEDY next
+        token and its model logprob at every window position.
+
+        Returns (cache, targets [B, W] int32, lps [B, W] f32).
+        """
+        from kaito_tpu.engine.sampler import chosen_logprob
+
+        B, W = tokens.shape
+        cache, logits = self.verify_window_logits(
+            params, cache, tokens, true_lens, page_tables, start_pos,
+            adapter_ids=adapter_ids)
         targets = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         flat_lp = chosen_logprob(logits.reshape(B * W, -1),
                                  targets.reshape(B * W))

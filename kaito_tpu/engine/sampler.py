@@ -115,6 +115,96 @@ def apply_penalties(logits: jax.Array, state: SamplingState,
     return jax.lax.cond(state.any_penalty, apply, lambda l: l, logits)
 
 
+def spec_verify_sample(target_logits: jax.Array, draft_logits: jax.Array,
+                       proposal: jax.Array, prop_len: jax.Array,
+                       temperature: jax.Array, onehot_q: jax.Array,
+                       keys: jax.Array):
+    """Leviathan-style speculative verification: accept a prefix of the
+    proposal, then draw one token from the residual distribution — the
+    emitted stream is distribution-identical to sampling the target
+    autoregressively (and bit-identical to greedy prefix-accept + bonus
+    when temperature == 0).
+
+    target_logits [B, W, V] fp32 (W = K+1 window positions);
+    draft_logits  [B, K, V] fp32 (draft dist at each proposed position;
+                  ignored where ``onehot_q`` or temperature == 0 — a
+                  deterministic proposer's q is one-hot at the proposal);
+    proposal      [B, K] int32; prop_len [B] valid proposal tokens;
+    temperature   [B]; onehot_q [B] bool (n-gram / deterministic rows);
+    keys          [B, 2] uint32 PRNG keys (speculation-private — the
+                  engine's SamplingState keys are never consumed here).
+
+    Returns (out [B, W] int32, n_emit [B] int32, lps [B, W] f32,
+    new_keys [B, 2]).  out[:, :n_emit] are the emitted tokens (accepted
+    prefix + one residual/bonus draw); positions >= n_emit are garbage.
+    lps are log p(token) under the UNMODIFIED target distribution
+    (OpenAI logprobs semantics, matching ``chosen_logprob``).
+    """
+    B, W, V = target_logits.shape
+    K = W - 1
+    greedy_row = temperature <= 0.0
+    temp = jnp.maximum(temperature, 1e-6)[:, None, None]
+    p_soft = jax.nn.softmax(target_logits / temp, axis=-1)
+    p_hot = jax.nn.one_hot(jnp.argmax(target_logits, axis=-1), V,
+                           dtype=p_soft.dtype)
+    p = jnp.where(greedy_row[:, None, None], p_hot, p_soft)     # [B, W, V]
+    q_soft = jax.nn.softmax(draft_logits / temp, axis=-1)
+    q_hot = jax.nn.one_hot(proposal, V, dtype=q_soft.dtype)
+    det = (onehot_q | greedy_row)[:, None, None]
+    q = jnp.where(det, q_hot, q_soft)                           # [B, K, V]
+
+    j = jnp.arange(K)[None, :]
+    valid = j < prop_len[:, None]                               # [B, K]
+    p_prop = jnp.take_along_axis(
+        p[:, :K], proposal[..., None], axis=-1)[..., 0]
+    q_prop = jnp.take_along_axis(q, proposal[..., None], axis=-1)[..., 0]
+    ratio = p_prop / jnp.maximum(q_prop, 1e-20)
+
+    def row_draws(key_data):
+        key = jax.random.wrap_key_data(key_data, impl="threefry2x32")
+        new_key, k_u, k_cat = jax.random.split(key, 3)
+        u = jax.random.uniform(k_u, (K,))
+        return jax.random.key_data(new_key), u, jax.random.key_data(k_cat)
+
+    new_keys, u, cat_keys = jax.vmap(row_draws)(keys)
+    accept = (u < ratio) & valid
+    # longest accepted PREFIX (a single rejection stops the row)
+    n = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=-1), axis=-1)
+
+    # residual at the first rejected position: max(p - q, 0) normalized;
+    # past the proposal (full accept / empty proposal) the "residual"
+    # is the target distribution itself (the bonus token)
+    p_n = jnp.take_along_axis(p, n[:, None, None], axis=1)[:, 0]  # [B, V]
+    q_pad = jnp.concatenate([q, jnp.zeros((B, 1, V), q.dtype)], axis=1)
+    q_n = jnp.take_along_axis(q_pad, n[:, None, None], axis=1)[:, 0]
+    q_n = jnp.where((n < prop_len)[:, None], q_n, 0.0)
+    resid = jnp.maximum(p_n - q_n, 0.0)
+    rs = jnp.sum(resid, axis=-1, keepdims=True)
+    resid = jnp.where(rs > 1e-12, resid / jnp.maximum(rs, 1e-12), p_n)
+
+    def row_cat(key_data, probs):
+        key = jax.random.wrap_key_data(key_data, impl="threefry2x32")
+        tok = jax.random.categorical(
+            key, jnp.log(jnp.maximum(probs, 1e-38)))
+        return tok.astype(jnp.int32)
+
+    extra_cat = jax.vmap(row_cat)(cat_keys, resid)
+    # greedy rows stay draw-free: one-hot residual -> exact argmax
+    extra = jnp.where(greedy_row,
+                      jnp.argmax(resid, axis=-1).astype(jnp.int32),
+                      extra_cat)
+
+    jj = jnp.arange(W)[None, :]
+    prop_pad = jnp.concatenate(
+        [proposal, jnp.zeros((B, 1), proposal.dtype)], axis=1)
+    out = jnp.where(jj < n[:, None], prop_pad, 0)
+    out = jnp.where(jj == n[:, None], extra[:, None], out)
+    out = out.astype(jnp.int32)
+    logp = jax.nn.log_softmax(target_logits, axis=-1)
+    lps = jnp.take_along_axis(logp, out[..., None], axis=-1)[..., 0]
+    return out, (n + 1).astype(jnp.int32), lps, new_keys
+
+
 def sample(logits: jax.Array, state: SamplingState,
            counts=None, prompt_seen=None) -> tuple[jax.Array, SamplingState]:
     """Sample one token per row. logits: [B, V] fp32; counts: optional

@@ -1234,6 +1234,22 @@ def main(argv=None):
                     help="prompt-lookup speculative decoding: propose up "
                          "to N tokens per step (0 = off; exact greedy "
                          "equivalence)")
+    ap.add_argument("--speculative-draft",
+                    default=os.environ.get("KAITO_SPEC_DRAFT", ""),
+                    help="draft preset for two-model speculative decoding "
+                         "(must share the target's tokenizer; '' = off). "
+                         "Greedy output stays bit-exact; sampled output "
+                         "stays distribution-identical (rejection "
+                         "sampling). See docs/speculative.md")
+    ap.add_argument("--speculative-draft-k", type=int,
+                    default=int(os.environ.get("KAITO_SPEC_DRAFT_K", "4")),
+                    help="max adaptive speculation depth per slot (the "
+                         "accept-rate controller moves within [1, K] and "
+                         "falls back to n-gram/plain on poor acceptance)")
+    ap.add_argument("--speculative-draft-weights-dir",
+                    default=os.environ.get("KAITO_SPEC_DRAFT_WEIGHTS", ""),
+                    help="safetensors dir for the draft's weights "
+                         "('' = synthetic)")
     ap.add_argument("--request-timeout-s", type=float, default=0.0,
                     help="server-default request deadline in seconds "
                          "(0 = none); expired requests get 408-style "
@@ -1286,6 +1302,9 @@ def main(argv=None):
         max_queue_len=args.max_queue_len,
         max_pages=args.max_pages,
         speculative_ngram=args.speculative_ngram,
+        speculative_draft=args.speculative_draft,
+        speculative_draft_k=args.speculative_draft_k,
+        speculative_draft_weights_dir=args.speculative_draft_weights_dir,
         request_timeout_s=args.request_timeout_s,
         kv_shed_threshold=args.kv_shed_threshold,
         kv_import_retries=args.kv_import_retries,

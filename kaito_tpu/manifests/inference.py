@@ -68,6 +68,16 @@ def build_engine_command(
         "kaito-tpu.io/kv-cache-dtype", "")
     if kv_dtype:
         args += ["--kv-cache-dtype", kv_dtype]
+    spec_draft = ws.metadata.annotations.get(
+        "kaito-tpu.io/speculative-draft", "")
+    if spec_draft:
+        # "auto" resolves to the preset's curated pairing here (the
+        # controller already validated it) so the pod command names a
+        # concrete catalog preset
+        from kaito_tpu.models.registry import resolve_speculative_draft
+        resolved = resolve_speculative_draft(md, spec_draft)
+        if resolved:
+            args += ["--speculative-draft", resolved]
     if config_file:
         args += ["--kaito-config-file", config_file]
     if adapters_dir:

@@ -210,6 +210,7 @@ def metadata_from_hf_config(
     download_auth_required: bool = False,
     quantization: str = "",
     tags: tuple[str, ...] = (),
+    speculative_draft: str = "",
 ) -> ModelMetadata:
     """Auto-generate a preset from a HF config dict (reference:
     ``GeneratePreset``, ``presets/workspace/generator/generator.go:805``)."""
@@ -250,4 +251,5 @@ def metadata_from_hf_config(
         tool_call_parser=tool_parser,
         reasoning_parser=reasoning_parser,
         runtime=runtime,
+        speculative_draft=speculative_draft,
     )

@@ -174,6 +174,10 @@ class ModelMetadata:
     # RuntimeName in pkg/model/interface.go + the text-generation
     # transformers runtime)
     runtime: str = "engine"
+    # default draft preset for two-model speculative decoding; "" = no
+    # curated pairing.  Resolved by the `kaito-tpu.io/speculative-draft:
+    # auto` annotation; serving stays non-speculative unless asked
+    speculative_draft: str = ""
 
     @property
     def file_bytes(self) -> int:

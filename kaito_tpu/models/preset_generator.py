@@ -43,6 +43,7 @@ def generate(hf_id: str, cfg: dict):
         "kv_bytes_per_token_bf16": md.kv_bytes_per_token(2),
         "kv_bytes_per_token_int8": md.kv_bytes_per_token(1),
         "model_file_bytes": md.file_bytes,
+        "speculative_draft": md.speculative_draft,
     }
     return md, out
 
@@ -62,6 +63,11 @@ def main(argv=None):
                     help="opt the plan preview into the >=32k serve CP "
                          "carve (evidence-gated off by default: BENCH_r05 "
                          "cp_speedup_vs_chunked=0.68)")
+    ap.add_argument("--speculative-draft", default="",
+                    help="draft preset for speculative decoding: a "
+                         "catalog name, or 'auto' for the curated "
+                         "pairing; validated against the target "
+                         "(tokenizer/runtime compatibility)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output")
     args = ap.parse_args(argv)
@@ -77,6 +83,23 @@ def main(argv=None):
         return 1
 
     md, out = generate(args.model, cfg)
+
+    # prefer the committed catalog entry when one matches: it carries
+    # the curated speculative_draft pairing the autogen path can't know
+    from kaito_tpu.models.registry import (get_model_by_name,
+                                           resolve_speculative_draft)
+    try:
+        md = get_model_by_name(args.model)
+        out["speculative_draft"] = md.speculative_draft
+    except KeyError:
+        pass
+    if args.speculative_draft:
+        try:
+            out["speculative_draft"] = resolve_speculative_draft(
+                md, args.speculative_draft)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
 
     try:
         from kaito_tpu.parallel.plan import plan_parallelism

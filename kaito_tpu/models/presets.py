@@ -46,10 +46,10 @@ def _llama(vocab, hidden, layers, heads, kv, inter, max_pos=131072, theta=500000
 _PRESETS: list[ModelMetadata] = []
 
 
-def _add(name, hf_id, cfg, *, auth=False, quant="", tags=()):
+def _add(name, hf_id, cfg, *, auth=False, quant="", tags=(), draft=""):
     md = metadata_from_hf_config(
         hf_id, cfg, name=name, download_auth_required=auth,
-        quantization=quant, tags=tuple(tags),
+        quantization=quant, tags=tuple(tags), speculative_draft=draft,
     )
     _PRESETS.append(md)
     return md
@@ -58,8 +58,12 @@ def _add(name, hf_id, cfg, *, auth=False, quant="", tags=()):
 # ---- Llama --------------------------------------------------------------
 _add("llama-3.1-8b-instruct", "meta-llama/Llama-3.1-8B-Instruct",
      _llama(128256, 4096, 32, 32, 8, 14336), auth=True)
+# curated draft pairing: same tokenizer family (vocab 128256), ~9x
+# smaller — the "auto" value of the kaito-tpu.io/speculative-draft
+# annotation resolves to this (docs/speculative.md)
 _add("llama-3.3-70b-instruct", "meta-llama/Llama-3.3-70B-Instruct",
-     _llama(128256, 8192, 80, 64, 8, 28672), auth=True)
+     _llama(128256, 8192, 80, 64, 8, 28672), auth=True,
+     draft="llama-3.1-8b-instruct")
 
 # ---- DeepSeek V3 / R1 (MLA + MoE) --------------------------------------
 _DEEPSEEK_V3 = {
@@ -214,7 +218,8 @@ def _qwen2(vocab, hidden, layers, heads, kv, inter, max_pos=32768):
 
 
 _add("qwen2.5-coder-7b-instruct", "Qwen/Qwen2.5-Coder-7B-Instruct", _qwen2(152064, 3584, 28, 28, 4, 18944))
-_add("qwen2.5-coder-32b-instruct", "Qwen/Qwen2.5-Coder-32B-Instruct", _qwen2(152064, 5120, 64, 40, 8, 27648))
+_add("qwen2.5-coder-32b-instruct", "Qwen/Qwen2.5-Coder-32B-Instruct", _qwen2(152064, 5120, 64, 40, 8, 27648),
+     draft="qwen2.5-coder-7b-instruct")
 _add("deepseek-r1-distill-qwen-14b", "deepseek-ai/DeepSeek-R1-Distill-Qwen-14B",
      _qwen2(152064, 5120, 48, 40, 8, 13824, max_pos=131072), tags=("reasoning",))
 _add("deepseek-r1-distill-llama-8b", "deepseek-ai/DeepSeek-R1-Distill-Llama-8B",
@@ -297,7 +302,8 @@ def _qwen3(vocab, hidden, layers, heads, kv, inter, head_dim=128, max_pos=40960)
 
 
 _add("qwen3-8b", "Qwen/Qwen3-8B", _qwen3(151936, 4096, 36, 32, 8, 12288))
-_add("qwen3-32b", "Qwen/Qwen3-32B", _qwen3(151936, 5120, 64, 64, 8, 25600))
+_add("qwen3-32b", "Qwen/Qwen3-32B", _qwen3(151936, 5120, 64, 64, 8, 25600),
+     draft="qwen3-8b")
 
 # ---- tiny test model (not in the reference; for CI and smoke runs) -----
 _add("tiny-llama-test", "kaito-tpu/tiny-llama-test",

@@ -75,3 +75,53 @@ def get_model_by_name(name: str) -> ModelMetadata:
         f"unknown model {name!r}; not a built-in preset and no config "
         f"fetcher produced a HuggingFace config for it"
     )
+
+
+def draft_compatibility_errors(target: ModelMetadata,
+                               draft: ModelMetadata) -> list[str]:
+    """Why ``draft`` cannot speculate for ``target`` (empty = ok).
+
+    Speculative decoding emits the DRAFT's token ids verbatim once the
+    target accepts them, so both presets must share one tokenizer.  The
+    catalog carries no tokenizer files, so vocab-size equality is the
+    enforced proxy (it is also exactly what ``load_tokenizer`` keys
+    on); the engine re-checks at load time.
+    """
+    errs: list[str] = []
+    if draft.runtime != "engine":
+        errs.append(f"draft preset {draft.name!r} runs on the "
+                    f"{draft.runtime!r} runtime; speculation needs the "
+                    f"first-party engine")
+    if draft.arch.vocab_size != target.arch.vocab_size:
+        errs.append(
+            f"draft preset {draft.name!r} vocab_size "
+            f"{draft.arch.vocab_size} != target {target.name!r} "
+            f"vocab_size {target.arch.vocab_size} (speculation requires "
+            f"a shared tokenizer)")
+    return errs
+
+
+def resolve_speculative_draft(target: ModelMetadata,
+                              annotation: str) -> str:
+    """Resolve the ``kaito-tpu.io/speculative-draft`` annotation (or
+    the ``--speculative-draft`` flag value) to a validated draft preset
+    name.  ``""`` disables; ``"auto"`` takes the target preset's
+    curated ``speculative_draft`` pairing (may be empty — serving then
+    stays non-speculative).  Raises ``ValueError`` on an unknown preset
+    or an incompatible pairing (surfaced as a controller condition).
+    """
+    name = (annotation or "").strip()
+    if name == "auto":
+        name = target.speculative_draft
+    if not name:
+        return ""
+    try:
+        draft = get_model_by_name(name)
+    except KeyError:
+        raise ValueError(
+            f"speculative draft preset {name!r} is not in the model "
+            f"catalog") from None
+    errs = draft_compatibility_errors(target, draft)
+    if errs:
+        raise ValueError("; ".join(errs))
+    return draft.name

@@ -266,6 +266,52 @@ def test_transfer_cost_counts_scale_bytes():
 
 
 # ---------------------------------------------------------------------------
+# int8 target KV x draft-model speculation (docs/speculative.md): the
+# draft keeps a private FP pool while the target pool is quantized,
+# and greedy output must match the pinned int8 goldens exactly
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_int8_kv_composes_with_draft_speculation():
+    import json
+    import os
+
+    repo = __file__.rsplit("/tests/", 1)[0]
+    ckpt = os.path.join(repo, "checkpoints", "tiny-llama-real")
+    goldens = os.path.join(os.path.dirname(__file__), "testdata",
+                           "goldens_tiny-llama-real.json")
+    if not (os.path.exists(os.path.join(ckpt, "model.safetensors"))
+            and os.path.exists(goldens)):
+        pytest.skip("no committed real checkpoint")
+    from kaito_tpu.engine.config import EngineConfig
+    from kaito_tpu.engine.engine import InferenceEngine, SamplingParams
+
+    golden = json.load(open(goldens))
+    cfg = EngineConfig(model="tiny-llama-real", weights_dir=ckpt,
+                       dtype="float32", kv_dtype="int8",
+                       max_model_len=512, max_num_seqs=2,
+                       prefill_buckets=(64, 128),
+                       enable_prefix_caching=False, seed=0,
+                       speculative_draft="tiny-llama-real",
+                       speculative_draft_k=4,
+                       speculative_draft_weights_dir=ckpt)
+    eng = InferenceEngine(cfg)
+    eng.start()
+    try:
+        assert eng.cache.quantized
+        assert not eng.spec_draft.cache.quantized  # draft pool stays fp
+        p = golden["prompts"][0]
+        want = p["kv_int8"]["greedy_tokens"]
+        req = eng.submit(list(p["prompt_tokens"]), SamplingParams(
+            max_tokens=len(want), temperature=0.0, ignore_eos=True))
+        got = [t for t in req.stream()]
+        assert got == want
+        assert eng.counters["spec_draft_steps_total"] >= 1
+    finally:
+        eng.stop()
+
+
+# ---------------------------------------------------------------------------
 # maintenance-window cron (satellite: direct last-fire computation)
 # ---------------------------------------------------------------------------
 
