@@ -2728,18 +2728,35 @@ class InferenceEngine:
             depths[i] = depth
         k_exec = max([depths[i] for i in rows], default=0)
         if k_exec > 0:
-            k_exec = 1 << (k_exec - 1).bit_length()   # pow2 program buckets
+            # pow2 program buckets, clamped to the verify window: with
+            # a non-pow2 speculative_draft_k the rounding must not push
+            # past W-1 — the verify program carries exactly W-1 draft
+            # positions (and every planned depth is <= W-1 already, so
+            # the clamp never cuts below a slot's depth)
+            k_exec = min(1 << (k_exec - 1).bit_length(), W - 1)
+            # the proposal scan writes k_exec draft-KV positions for
+            # every drafting row, not depths[i]: reserve pages for the
+            # full bucket; a slot that can't is demoted to a plain
+            # ride-along step this round
+            for i in rows:
+                if depths[i] > 0 and not runner.ensure_pages(
+                        i, self.slots[i].position + k_exec):
+                    depths[i] = 0
+            if not any(depths[i] > 0 for i in rows):
+                k_exec = 0
 
         # n-gram fallback proposals (controller-demoted slots)
         proposals: dict[int, list[int]] = {}
         any_prop = k_exec > 0
         for i in rows:
             p: list[int] = []
-            if depths[i] == 0 and ctl.mode(i) == "ngram" \
-                    and cfg.speculative_ngram > 0:
-                slot = self.slots[i]
-                p = self._propose(i, slot.request)
-                p = p[: max(0, min(slot.remaining - 1, W - 1))]
+            if depths[i] == 0 and ctl.mode(i) == "ngram":
+                if cfg.speculative_ngram > 0:
+                    slot = self.slots[i]
+                    p = self._propose(i, slot.request)
+                    p = p[: max(0, min(slot.remaining - 1, W - 1))]
+                # probation must tick whether or not the n-gram
+                # proposer is enabled — it is what re-arms the draft
                 ctl.note_fallback_round(i)
             proposals[i] = p
             any_prop = any_prop or bool(p)
@@ -2838,11 +2855,15 @@ class InferenceEngine:
                            logprob=float(lps[r, j]) if want_lp else None)
                 self.last_tokens[i] = t
             if slot.request is not None and depths[i] > 0:
-                # steady-state invariant: the draft KV's valid prefix
-                # equals the new position — the next round needs no
-                # catch-up (rejected-position writes get overwritten
-                # before anything can attend to them)
-                runner.commit(i, slot.position)
+                # the proposal scan wrote draft KV at sp..sp+k_exec-1
+                # (valid prefix sp+k_exec).  On a full-depth full-accept
+                # round the new position is sp+k_exec+1 — one past what
+                # was written — so commit only what exists and let
+                # sync() backfill the last accepted token's KV next
+                # round.  Every other round commits the new position
+                # exactly (rejected-position writes get overwritten
+                # before anything can attend to them).
+                runner.commit(i, min(slot.position, int(sp[r]) + k_exec))
             max_emitted = max(max_emitted, len(emitted))
         return max_emitted
 

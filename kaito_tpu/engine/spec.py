@@ -45,36 +45,55 @@ logger = logging.getLogger(__name__)
 class NgramIndex:
     """Last-occurrence index over one request's token stream.
 
-    Replaces the old per-step rescan of the trailing 4096-token context:
-    a dict maps each ``k``-gram (that has at least one following token)
-    to its NEWEST start offset.  ``append`` is O(1) per emitted token;
-    ``propose`` is one dict probe.  Matching the scan's semantics, the
-    gram ending at the current tail is indexed only once a token
-    follows it — a lookup never matches the tail itself.
+    Replaces the old per-step rescan of the trailing ``window``-token
+    context (4096, the scan's bound): a dict maps each ``k``-gram (that
+    has at least one following token) to its NEWEST start offset.
+    ``append`` is O(1) amortized per emitted token; ``propose`` is one
+    dict probe.  Matching the scan's semantics, the gram ending at the
+    current tail is indexed only once a token follows it — a lookup
+    never matches the tail itself — and a match older than the trailing
+    window is a miss, exactly as it fell off the scanned context
+    before.  Memory stays O(window): the retained token buffer is
+    trimmed and stale dict entries are swept as the stream grows.
     """
 
-    def __init__(self, k: int, tokens):
+    def __init__(self, k: int, tokens, window: int = 4096):
         self.k = k
-        self.tokens = [int(t) for t in tokens]
-        self.last: dict[tuple, int] = {}
-        n = len(self.tokens)
-        for end in range(k - 1, n - 1):
-            self.last[tuple(self.tokens[end - k + 1:end + 1])] = end - k + 1
+        self.window = max(int(window), k + 1)
+        toks = [int(t) for t in tokens]
+        self.n = len(toks)                 # absolute stream length
+        self.off = max(0, self.n - self.window)   # abs index of buf[0]
+        self.tokens = toks[self.off:]      # trailing retained buffer
+        self.last: dict[tuple, int] = {}   # gram -> newest ABS start
+        for end in range(k - 1, len(self.tokens) - 1):
+            self.last[tuple(self.tokens[end - k + 1:end + 1])] = \
+                self.off + end - k + 1
+        self._sweep_at = self.n + self.window
 
     def append(self, tok: int) -> None:
         self.tokens.append(int(tok))
-        m = len(self.tokens) - 2      # previous tail index: it now has
-        if m >= self.k - 1:           # a follower, so its gram is usable
-            self.last[tuple(self.tokens[m - self.k + 1:m + 1])] = \
+        self.n += 1
+        m = self.n - 2                # previous tail ABS index: it now
+        if m - self.k + 1 >= self.off:  # has a follower, gram is usable
+            rel = m - self.off
+            self.last[tuple(self.tokens[rel - self.k + 1:rel + 1])] = \
                 m - self.k + 1
+        if len(self.tokens) > 2 * self.window:   # amortized front trim
+            cut = len(self.tokens) - self.window
+            del self.tokens[:cut]
+            self.off += cut
+        if self.n >= self._sweep_at:  # periodic stale-entry sweep
+            lo = self.n - self.window
+            self.last = {g: s for g, s in self.last.items() if s >= lo}
+            self._sweep_at = self.n + self.window
 
     def propose(self, max_tokens: int) -> list[int]:
-        if len(self.tokens) < self.k + 1 or max_tokens <= 0:
+        if self.n < self.k + 1 or max_tokens <= 0:
             return []
         start = self.last.get(tuple(self.tokens[-self.k:]))
-        if start is None:
-            return []
-        lo = start + self.k
+        if start is None or start < self.n - self.window:
+            return []   # no occurrence inside the trailing window
+        lo = start + self.k - self.off
         return self.tokens[lo:lo + max_tokens]
 
 
@@ -174,12 +193,17 @@ class DraftRunner:
     streams are never consumed by speculation), chunked catch-up
     prefill, and the jitted K-step proposal scan.
 
-    Invariant mirrored from the engine: after a verification round that
-    accepted ``a`` tokens starting from position ``p``, the draft KV's
-    valid prefix is exactly ``p + a + 1`` — the new target position —
-    so steady-state rounds need zero catch-up.  Rejected-position
-    entries past the valid prefix are overwritten before any later step
-    can attend to them (attention lengths track the valid prefix).
+    Invariant mirrored from the engine: a round's proposal scan writes
+    draft KV at positions ``p .. p + k_exec - 1`` (last committed token
+    plus the first ``k_exec - 1`` proposals), so after a verification
+    round that accepted ``a`` of ``k_exec`` proposals the engine
+    commits ``min(p + a + 1, p + k_exec)`` — the new target position,
+    except after a full-accept round, where the last accepted token's
+    KV was never written and ``sync`` backfills the one-token gap at
+    the start of the next round.  Steady-state partial-accept rounds
+    need zero catch-up.  Rejected-position entries past the valid
+    prefix are overwritten before any later step can attend to them
+    (attention lengths track the valid prefix).
     """
 
     def __init__(self, engine):
@@ -441,6 +465,8 @@ class DraftRunner:
         self.keys = self.keys.at[idx].set(new_keys[jnp.asarray(rows)])
 
     def commit(self, i: int, new_position: int) -> None:
-        """After a verify round: the draft KV valid prefix equals the
-        new target position (see class docstring)."""
+        """After a verify round: advance the draft KV valid prefix.
+        The engine passes min(new target position, p + k_exec) — never
+        past what the proposal scan actually wrote (class docstring);
+        any remaining gap is prefilled by ``sync`` next round."""
         self.pos[i] = new_position

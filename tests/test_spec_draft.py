@@ -159,6 +159,38 @@ def test_ngram_index_never_matches_own_tail():
     assert idx2.propose(4) == []
 
 
+def test_ngram_index_match_falls_out_of_window():
+    # single early occurrence of the tail gram: in-window it proposes,
+    # once older than `window` it is a miss — the scan's old bound
+    idx = NgramIndex(2, [5, 6, 9], window=8)
+    idx.append(5)
+    idx.append(6)
+    assert idx.propose(3) == [9, 5, 6]
+    idx2 = NgramIndex(2, [5, 6, 9], window=8)
+    for t in range(20, 27):
+        idx2.append(t)
+    idx2.append(5)
+    idx2.append(6)       # start 0 < n - window: stale
+    assert idx2.propose(3) == []
+
+
+def test_ngram_index_windowed_matches_windowed_scan():
+    rng = np.random.RandomState(7)
+    toks = rng.randint(0, 4, 300).tolist()   # tiny alphabet: many hits
+    W = 32
+    idx = NgramIndex(2, toks[:5], window=W)
+    cur = toks[:5]
+    for t in toks[5:]:
+        idx.append(t)
+        cur.append(t)
+        for m in (1, 6):
+            assert idx.propose(m) == _scan_propose(cur[-W:], 2, m), \
+                f"diverged at len={len(cur)} max_tokens={m}"
+    # memory stays O(window): buffer trimmed, stale entries swept
+    assert len(idx.tokens) <= 2 * W
+    assert all(s >= idx.n - 2 * W for s in idx.last.values())
+
+
 # ---------------------------------------------------------------------------
 # spec_verify_sample: exactness properties
 # ---------------------------------------------------------------------------
@@ -279,6 +311,61 @@ def test_draft_greedy_equivalence_and_fewer_steps():
     assert eng.counters["spec_draft_steps_total"] >= 1
     assert eng.counters["decode_steps_total"] < 32
     assert eng.counters["spec_draft_accepted_tokens_total"] > 0
+
+
+@pytest.mark.slow
+def test_non_pow2_draft_k_clamps_to_verify_window():
+    """speculative_draft_k=3: once the controller reaches full depth
+    the pow2 program bucket (4) must clamp to W-1=3 — regression for a
+    shape mismatch inside the fused verify that killed the decode
+    step."""
+    ref = _mk()
+    out_ref = _drive(ref, [ref.submit(REPEAT_PROMPT, _greedy(32))])
+    eng = _mk(draft="tiny-llama-test", speculative_draft_k=3)
+    out = _drive(eng, [eng.submit(REPEAT_PROMPT, _greedy(32))])
+    assert out == out_ref
+    assert eng.counters["spec_draft_steps_total"] >= 1
+    assert eng.counters["spec_draft_accepted_tokens_total"] > 0
+
+
+@pytest.mark.slow
+def test_full_accept_rounds_keep_draft_kv_exact():
+    """Self-draft greedy full-accept steady state: identical weights
+    mean nothing is ever rejected — IF the draft KV stays exact.
+    Regression for the full-accept hole: commit() claimed one position
+    past what the proposal scan wrote, so the next round attended over
+    garbage and acceptance collapsed to ~0.5 in exactly the
+    high-acceptance steady state."""
+    ref = _mk()
+    out_ref = _drive(ref, [ref.submit(REPEAT_PROMPT, _greedy(32))])
+    eng = _mk(draft="tiny-llama-test")
+    out = _drive(eng, [eng.submit(REPEAT_PROMPT, _greedy(32))])
+    assert out == out_ref
+    prop = eng.counters["spec_draft_proposed_tokens_total"]
+    acc = eng.counters["spec_draft_accepted_tokens_total"]
+    assert prop > 0 and acc == prop
+
+
+@pytest.mark.slow
+def test_probation_ticks_without_ngram_proposer():
+    """A demoted slot must tick probation (and re-arm the draft) even
+    with speculative_ngram=0, the default — regression for a permanent
+    draft disable when the n-gram proposer is off."""
+    eng = _mk(draft="tiny-llama-test")
+    assert eng.cfg.speculative_ngram == 0
+    req = eng.submit(REPEAT_PROMPT, _greedy(24))
+    eng.step()                  # prefill; slot 0 now decoding
+    ctl = eng.spec_ctl
+    ctl._mode[0] = "ngram"      # as sustained-poor acceptance would
+    ctl._probation[0] = 2
+    steps = 0
+    while ctl.mode(0) == "ngram":
+        assert not req.finish_reason and steps < 10
+        eng.step()
+        steps += 1
+    assert ctl.mode(0) == "draft" and ctl.depth(0) == 1
+    _drive(eng, [req])          # and the request still completes
+    assert len(req.output_tokens) == 24
 
 
 @pytest.mark.slow
