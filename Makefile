@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: all native unit-test unit-test-fast unit-test-slow engine-test rag-test chaos kvq obs slo spec bench serve manager epp clean
+.PHONY: all native unit-test unit-test-fast unit-test-slow engine-test rag-test chaos kvq obs slo fleet spec bench serve manager epp clean
 
 all: native
 
@@ -47,11 +47,18 @@ kvq:
 # legs run under unit-test / unit-test-slow)
 obs:
 	$(PYTHON) -m pytest tests/test_tracing.py tests/test_metrics_format.py \
-	  tests/test_slo.py tests/test_controllers.py -q -m "not slow"
+	  tests/test_slo.py tests/test_controllers.py tests/test_fleet.py \
+	  -q -m "not slow"
 
 # SLO watchdog suite alone (docs/observability.md "Control plane")
 slo:
 	$(PYTHON) -m pytest tests/test_slo.py -q
+
+# fleet telemetry plane (docs/observability.md "Fleet telemetry"):
+# evaluator hysteresis, discovery, fold/gauge round-trips, concurrent
+# scraping — fast tier; the two-real-replica scrape e2e is the slow leg
+fleet:
+	$(PYTHON) -m pytest tests/test_fleet.py -q -m "not slow"
 
 # speculative-decoding suite (docs/speculative.md): n-gram + draft
 # model paths — rejection sampler properties, adaptive-depth

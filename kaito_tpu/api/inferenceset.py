@@ -51,6 +51,10 @@ class InferenceSetStatus:
     selector: str = ""                  # scale-subresource label selector
     conditions: list[Condition] = field(default_factory=list)
     aggregated_peak_tokens_per_minute: float = 0.0
+    # fleet telemetry plane (runtime/fleet.py): rolling scaling signal
+    # + replica hint.  Read-side only — nothing actuates on these yet.
+    scaling_signal: str = ""            # idle|nominal|pressure|saturated
+    recommended_replicas: int = 0
 
 
 class InferenceSet(KaitoObject):

@@ -28,6 +28,9 @@ COND_WORKSPACE_SUCCEEDED = "WorkspaceSucceeded"
 COND_BENCHMARK_COMPLETE = "BenchmarkComplete"
 # folded from the benchmark probe's /debug/slo verdict (runtime/slo.py)
 COND_SLO_HEALTHY = "SLOHealthy"
+# fleet telemetry verdict (runtime/fleet.py): True when a scaling
+# action is signalled (pressure/saturated/idle), False when nominal
+COND_SCALING_SIGNAL = "ScalingSignal"
 
 # annotations / labels (our namespace, same roles as kaito.sh/*)
 ANNOTATION_DISABLE_BENCHMARK = "kaito-tpu.io/disable-benchmark"

@@ -25,6 +25,7 @@ from kaito_tpu.controllers.runtime import Store
 from kaito_tpu.controllers.workspace import WorkspaceReconciler
 from kaito_tpu.featuregates import parse_feature_gates
 from kaito_tpu.provision import new_node_provisioner
+from kaito_tpu.runtime.fleet import FleetTelemetry
 
 logger = logging.getLogger(__name__)
 
@@ -68,6 +69,10 @@ class Manager:
         self.autoupgrade = (
             AutoUpgradeRunner(self.store, base_image_version)
             if self.gates["enableBaseImageAutoUpgrade"] else None)
+        # fleet telemetry plane (ROADMAP item 1's read side): cheap to
+        # construct — no threads or sockets until run()/start()
+        self.fleet = FleetTelemetry(self.store)
+        self.fleet.register_metrics(self.metrics.registry)
 
         self._stop = threading.Event()
 
@@ -112,12 +117,23 @@ class Manager:
         if self.autoupgrade:
             self.autoupgrade.tick()
         self.metrics.refresh_conditions(self.store)
+        # fleet pass: rebuild targets from the store, then fold the
+        # latest scrapes into signals.  No-op when nothing reported.
+        try:
+            self.fleet.refresh_targets()
+            self.fleet.apply_signals()
+        except Exception:
+            logger.exception("fleet telemetry pass failed")
 
     def run(self, interval: float = 2.0) -> None:
         logger.info("manager running; gates=%s", self.gates)
-        while not self._stop.is_set():
-            self.resync()
-            self._stop.wait(interval)
+        self.fleet.start()
+        try:
+            while not self._stop.is_set():
+                self.resync()
+                self._stop.wait(interval)
+        finally:
+            self.fleet.stop()
 
     def stop(self) -> None:
         self._stop.set()
@@ -175,7 +191,8 @@ def main(argv=None):
                   feature_gates=args.feature_gates,
                   base_image_version=args.base_image_version)
     if args.metrics_port:
-        start_manager_server(mgr.metrics, port=args.metrics_port)
+        start_manager_server(mgr.metrics, port=args.metrics_port,
+                             fleet=mgr.fleet)
     if store is not None:
         # informer analogue: watch streams feed the expectations and
         # event-driven callbacks registered by the reconcilers

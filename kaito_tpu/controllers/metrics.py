@@ -8,7 +8,8 @@ gauges, emitted-Event counters, and a ``RingTracer`` of reconcile
 spans so a slow reconcile is diagnosable at ``/debug/trace`` exactly
 the way a slow request is on the engine server.
 
-``make_manager_server`` serves ``/metrics``, ``/debug/trace`` and
+``make_manager_server`` serves ``/metrics``, ``/debug/trace``,
+``/debug/fleet`` (when a ``FleetTelemetry`` plane is attached) and
 ``/healthz`` on ``--metrics-port`` (the port the Helm chart already
 exposes as the manager's ``metrics`` containerPort).
 """
@@ -107,6 +108,7 @@ class ManagerMetrics:
 
 class ManagerHandler(BaseHTTPRequestHandler):
     metrics: ManagerMetrics   # injected by make_manager_server
+    fleet = None              # FleetTelemetry, when the manager runs one
     protocol_version = "HTTP/1.1"
 
     def log_message(self, fmt, *args):
@@ -131,6 +133,13 @@ class ManagerHandler(BaseHTTPRequestHandler):
             tid = q.get("trace_id", [None])[0]
             payload = chrome_trace(mm.tracer.spans(tid))
             self._send(200, json.dumps(payload).encode(), "application/json")
+        elif self.path == "/debug/fleet":
+            if self.fleet is None:
+                self._send(404, b'{"error": "fleet telemetry disabled"}',
+                           "application/json")
+            else:
+                self._send(200, json.dumps(self.fleet.snapshot()).encode(),
+                           "application/json")
         elif self.path == "/healthz":
             self._send(200, b'{"status": "ok"}', "application/json")
         else:
@@ -138,22 +147,25 @@ class ManagerHandler(BaseHTTPRequestHandler):
 
 
 def make_manager_server(metrics: ManagerMetrics, host: str = "0.0.0.0",
-                        port: int = 8080) -> ThreadingHTTPServer:
-    handler = type("Handler", (ManagerHandler,), {"metrics": metrics})
+                        port: int = 8080, fleet=None) -> ThreadingHTTPServer:
+    handler = type("Handler", (ManagerHandler,),
+                   {"metrics": metrics, "fleet": fleet})
     return ThreadingHTTPServer((host, port), handler)
 
 
 def start_manager_server(metrics: ManagerMetrics, host: str = "0.0.0.0",
-                         port: int = 8080) -> Optional[ThreadingHTTPServer]:
+                         port: int = 8080,
+                         fleet=None) -> Optional[ThreadingHTTPServer]:
     """Spawn the metrics server on a daemon thread (None on bind
     failure — observability must not take the control plane down)."""
     try:
-        server = make_manager_server(metrics, host, port)
+        server = make_manager_server(metrics, host, port, fleet=fleet)
     except OSError:
         logger.exception("manager metrics server bind failed on :%s", port)
         return None
     threading.Thread(target=server.serve_forever, daemon=True,
                      name="manager-metrics").start()
-    logger.info("manager metrics on :%s (/metrics, /debug/trace)",
-                server.server_address[1])
+    logger.info("manager metrics on :%s (/metrics, /debug/trace%s)",
+                server.server_address[1],
+                ", /debug/fleet" if fleet is not None else "")
     return server

@@ -13,9 +13,27 @@ docs/observability.md for the full inventory.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Iterable, Mapping, Optional
+
+
+def process_rss_bytes() -> float:
+    """Resident set size of this process, dependency-free: /proc on
+    Linux, getrusage fallback elsewhere, 0.0 when neither works."""
+    try:
+        with open("/proc/self/statm") as f:
+            return float(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+    except Exception:
+        pass
+    try:
+        import resource
+
+        return float(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss) \
+            * 1024.0
+    except Exception:
+        return 0.0
 
 
 class Counter:
@@ -267,6 +285,16 @@ class EngineMetrics:
                      0.5, 1.0))
         self.e2e_latency = Histogram(
             "kaito:e2e_request_latency_seconds", "End-to-end request latency", r)
+        # process-level gauges: fleet rollups use uptime to tell a
+        # restarted replica (counters reset, uptime tiny) from a quiet
+        # one, and RSS to spot a leaking replica before the OOM-killer
+        self._started_monotonic = time.monotonic()
+        Gauge("kaito:process_uptime_seconds",
+              "Seconds since this serving process started", r,
+              fn=lambda: time.monotonic() - self._started_monotonic)
+        Gauge("kaito:process_resident_memory_bytes",
+              "Resident set size of the serving process", r,
+              fn=process_rss_bytes)
         if engine is not None:
             # the engine owns its step/queue-wait histograms (observed
             # from the scheduler thread); expose them through this
@@ -276,17 +304,24 @@ class EngineMetrics:
                 if h is not None:
                     r.register(h)
 
-            def _occupancy():
+            def _slots_total():
                 slots = getattr(engine, "slots", None)
                 if slots is not None:
-                    denom = len(slots)
-                else:
-                    denom = engine.cfg.max_num_seqs * max(
-                        1, getattr(engine.cfg, "data_parallel", 1))
-                return engine.num_running / max(1, denom)
+                    return len(slots)
+                return engine.cfg.max_num_seqs * max(
+                    1, getattr(engine.cfg, "data_parallel", 1))
+
+            def _occupancy():
+                return engine.num_running / max(1, _slots_total())
 
             Gauge("kaito:batch_occupancy",
                   "Active decode slots / max batch size", r, fn=_occupancy)
+            # absolute slot gauges next to the ratio: fleet rollups sum
+            # these across replicas (a ratio can't be summed)
+            Gauge("kaito:active_slots", "Decode slots occupied right now",
+                  r, fn=lambda: engine.num_running)
+            Gauge("kaito:slots_total", "Decode slot capacity", r,
+                  fn=_slots_total)
             Gauge("kaito:num_requests_running", "Active decode slots", r,
                   fn=lambda: engine.num_running)
             Gauge("kaito:num_requests_waiting", "Queued requests", r,

@@ -314,10 +314,23 @@ def parse_load_metrics(text: str) -> dict[str, float]:
     return out
 
 
+# fields the load parser may populate; anything else the parser ever
+# returns is dropped instead of setattr-poked into the snapshot
+_LOAD_FIELDS = frozenset(s for s in BackendLoad.__slots__ if s != "ts")
+# a scrape missing any of these is PARTIAL (truncated payload, wrong
+# process behind the port): keep the old snapshot and its stale ts
+_LOAD_REQUIRED = ("occupancy", "waiting", "kv_usage")
+
+
 def scrape_backend_load(b: Backend, timeout: float = 5.0) -> bool:
     """GET one replica's /metrics and fold the load gauges into
     ``b.load`` + its hysteresis state.  Returns False (and leaves the
-    old snapshot in place) when the replica is unreachable."""
+    old snapshot in place, stale ts included) when the replica is
+    unreachable or the payload is missing the core load series.
+
+    The new snapshot is built aside and swapped in whole, so a
+    concurrent scorer never reads a half-updated mix of old and new
+    gauges stamped with a fresh ``ts``."""
     try:
         conn = http.client.HTTPConnection(b.host, b.port, timeout=timeout)
         try:
@@ -330,66 +343,117 @@ def scrape_backend_load(b: Backend, timeout: float = 5.0) -> bool:
             conn.close()
     except (ConnectionError, OSError):
         return False
+    if any(k not in vals for k in _LOAD_REQUIRED):
+        return False
+    fresh = BackendLoad()
+    fresh.page_size = b.load.page_size      # optional series: carry over
     for key, v in vals.items():
-        setattr(b.load, key, v)
-    b.load.ts = time.monotonic()
+        if key in _LOAD_FIELDS:
+            setattr(fresh, key, v)
+    fresh.ts = time.monotonic()
+    b.load = fresh
     update_saturation(b)
     return True
 
 
-class MetricsScraper(threading.Thread):
-    """Background load scraper: keeps every backend's ``load`` snapshot
-    fresh so scoring never blocks a request on a network round trip."""
+class _BackendPoller(threading.Thread):
+    """Shared loop shape for the background scraper/prober: the first
+    pass runs IMMEDIATELY (not after the first interval sleep), every
+    pass polls the backends CONCURRENTLY, and a per-backend in-flight
+    guard skips a backend whose previous poll has not returned yet — so
+    one hung-but-alive replica degrades only its own freshness, never
+    the cadence of the others (the old serial loop let a single 5 s
+    timeout starve every backend behind it)."""
 
-    def __init__(self, core: "RoutingCore", interval_s: float = 1.0):
-        super().__init__(daemon=True, name="routing-metrics-scraper")
-        self.core = core
+    def __init__(self, name: str, interval_s: float):
+        super().__init__(daemon=True, name=name)
         self.interval_s = interval_s
         self._stop = threading.Event()
+        self._inflight: set[int] = set()
+        self._guard = threading.Lock()
 
     def stop(self) -> None:
         self._stop.set()
 
+    def targets(self) -> Iterable[Backend]:
+        raise NotImplementedError
+
+    def poll_one(self, b: Backend) -> None:
+        raise NotImplementedError
+
+    def poll_pass(self) -> None:
+        for b in self.targets():
+            with self._guard:
+                if id(b) in self._inflight:
+                    continue            # previous poll still hanging
+                self._inflight.add(id(b))
+            threading.Thread(target=self._poll_guarded, args=(b,),
+                             daemon=True, name=f"{self.name}-worker").start()
+
+    def _poll_guarded(self, b: Backend) -> None:
+        try:
+            self.poll_one(b)
+        except Exception:
+            logger.debug("%s: poll of %s failed", self.name, b.url,
+                         exc_info=True)
+        finally:
+            with self._guard:
+                self._inflight.discard(id(b))
+
     def run(self) -> None:
+        self.poll_pass()                # first pass now, not at t+interval
         while not self._stop.wait(self.interval_s):
-            for b in self.core.backends:
-                if b.alive:
-                    scrape_backend_load(b)
+            self.poll_pass()
 
 
-class HealthProber(threading.Thread):
+class MetricsScraper(_BackendPoller):
+    """Background load scraper: keeps every backend's ``load`` snapshot
+    fresh so scoring never blocks a request on a network round trip."""
+
+    def __init__(self, core: "RoutingCore", interval_s: float = 1.0,
+                 timeout_s: float = 2.0):
+        super().__init__("routing-metrics-scraper", interval_s)
+        self.core = core
+        self.timeout_s = timeout_s
+
+    def targets(self) -> Iterable[Backend]:
+        return [b for b in self.core.backends if b.alive]
+
+    def poll_one(self, b: Backend) -> None:
+        scrape_backend_load(b, timeout=self.timeout_s)
+
+
+class HealthProber(_BackendPoller):
     """Background ``/health`` probe per backend: closes breakers as
     replicas recover, opens them when a live-looking backend refuses
     the probe — without spending client requests on discovery."""
 
-    def __init__(self, router: "RoutingCore", interval_s: float = 2.0):
-        super().__init__(daemon=True, name="dp-health-prober")
+    def __init__(self, router: "RoutingCore", interval_s: float = 2.0,
+                 timeout_s: float = 5.0):
+        super().__init__("dp-health-prober", interval_s)
         self.router = router
-        self.interval_s = interval_s
-        self._stop = threading.Event()
+        self.timeout_s = timeout_s
 
-    def stop(self) -> None:
-        self._stop.set()
+    def targets(self) -> Iterable[Backend]:
+        return list(self.router.backends)
 
-    def run(self) -> None:
-        while not self._stop.wait(self.interval_s):
-            for b in self.router.backends:
-                try:
-                    conn = http.client.HTTPConnection(b.host, b.port,
-                                                      timeout=5)
-                    try:
-                        conn.request("GET", "/health")
-                        ok = conn.getresponse().status == 200
-                    finally:
-                        conn.close()
-                except (ConnectionError, OSError):
-                    ok = False
-                if ok:
-                    if b.failures:
-                        logger.info("health probe: %s recovered", b.url)
-                    b.mark_up()
-                elif b.alive:
-                    b.mark_down()
+    def poll_one(self, b: Backend) -> None:
+        try:
+            conn = http.client.HTTPConnection(b.host, b.port,
+                                              timeout=self.timeout_s)
+            try:
+                conn.request("GET", "/health")
+                ok = conn.getresponse().status == 200
+            finally:
+                conn.close()
+        except (ConnectionError, OSError):
+            ok = False
+        if ok:
+            if b.failures:
+                logger.info("health probe: %s recovered", b.url)
+            b.mark_up()
+        elif b.alive:
+            b.mark_down()
 
 
 def _retryable(method: str, path: str) -> bool:

@@ -91,8 +91,13 @@ class SLOTargets:
         }
 
 
-class _WindowSeries:
-    """Timestamped samples pruned to the longest window (bounded)."""
+class WindowSeries:
+    """Timestamped samples pruned to the longest window (bounded).
+
+    Shared with the fleet telemetry plane
+    (``kaito_tpu/runtime/fleet.py``), which keeps one of these per
+    InferenceSet per signal — the same multi-window rolling design,
+    lifted from one process to the fleet."""
 
     def __init__(self, max_window_s: float, time_fn: Callable[[], float]):
         self.max_window_s = max_window_s
@@ -164,11 +169,11 @@ class SLOWatchdog:
         self.time_fn = time_fn
         self._t0 = time_fn()
         slow = self.window_slow_s
-        self.ttft = _WindowSeries(slow, time_fn)
-        self.tokens = _WindowSeries(slow, time_fn)     # per-request counts
-        self.success = _WindowSeries(slow, time_fn)
-        self.failure = _WindowSeries(slow, time_fn)
-        self.shed = _WindowSeries(slow, time_fn)
+        self.ttft = WindowSeries(slow, time_fn)
+        self.tokens = WindowSeries(slow, time_fn)     # per-request counts
+        self.success = WindowSeries(slow, time_fn)
+        self.failure = WindowSeries(slow, time_fn)
+        self.shed = WindowSeries(slow, time_fn)
 
     # -- feeds ---------------------------------------------------------
 
@@ -248,9 +253,16 @@ class SLOWatchdog:
         alerts["throughput"] = _alert_state(
             1.5 if fast["throughput_burning"] else 0.0,
             1.5 if slow["throughput_burning"] else 0.0)
+        # single worst fast-window burn across every SLI (throughput
+        # folded in as its synthetic 1.5/0.0): the fleet telemetry
+        # plane scrapes exactly this one field per replica instead of
+        # walking the nested burn_rates dict (docs/observability.md)
+        burn_max = max([b["fast"] for b in burn_rates.values()]
+                       + [1.5 if fast["throughput_burning"] else 0.0])
         fast.pop("burn"), slow.pop("burn")
         fast.pop("throughput_burning"), slow.pop("throughput_burning")
         return {
+            "burn_max": round(burn_max, 4),
             "targets": self.targets.to_dict(),
             "windows": {"fast_s": self.window_fast_s,
                         "slow_s": self.window_slow_s},

@@ -17,6 +17,30 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from kaito_tpu.tuning.trainer import METRICS_FILE, SENTINEL
 
 
+def render_metrics(m: dict, done: bool) -> str:
+    """The sidecar's Prometheus payload (series live in the same
+    ``kaito:`` namespace as the engine's — docs/observability.md — so
+    one scrape config covers both; the exposition suite round-trips
+    this through the shared parser)."""
+    lines = [
+        "# HELP kaito:tuning_step Last trainer optimizer step",
+        "# TYPE kaito:tuning_step gauge",
+        f"kaito:tuning_step {m.get('step', 0)}",
+        "# HELP kaito:tuning_loss Last reported training loss",
+        "# TYPE kaito:tuning_loss gauge",
+        f"kaito:tuning_loss {m.get('loss', 0.0)}",
+        "# HELP kaito:tuning_tokens_per_second Trainer throughput",
+        "# TYPE kaito:tuning_tokens_per_second gauge",
+        f"kaito:tuning_tokens_per_second "
+        f"{m.get('tokens_per_second', 0.0)}",
+        "# HELP kaito:tuning_completed 1 once the job sentinel "
+        "file exists",
+        "# TYPE kaito:tuning_completed gauge",
+        f"kaito:tuning_completed {1 if done else 0}",
+    ]
+    return "\n".join(lines) + "\n"
+
+
 class Handler(BaseHTTPRequestHandler):
     results_dir = ""
 
@@ -37,25 +61,7 @@ class Handler(BaseHTTPRequestHandler):
         elif self.path == "/metrics":
             m = self._read()
             done = os.path.exists(os.path.join(self.results_dir, SENTINEL))
-            # series live in the same kaito: namespace as the engine's
-            # (docs/observability.md) so one scrape config covers both
-            lines = [
-                "# HELP kaito:tuning_step Last trainer optimizer step",
-                "# TYPE kaito:tuning_step gauge",
-                f"kaito:tuning_step {m.get('step', 0)}",
-                "# HELP kaito:tuning_loss Last reported training loss",
-                "# TYPE kaito:tuning_loss gauge",
-                f"kaito:tuning_loss {m.get('loss', 0.0)}",
-                "# HELP kaito:tuning_tokens_per_second Trainer throughput",
-                "# TYPE kaito:tuning_tokens_per_second gauge",
-                f"kaito:tuning_tokens_per_second "
-                f"{m.get('tokens_per_second', 0.0)}",
-                "# HELP kaito:tuning_completed 1 once the job sentinel "
-                "file exists",
-                "# TYPE kaito:tuning_completed gauge",
-                f"kaito:tuning_completed {1 if done else 0}",
-            ]
-            body = ("\n".join(lines) + "\n").encode()
+            body = render_metrics(m, done).encode()
             ctype = "text/plain; version=0.0.4"
         elif self.path == "/progress":
             body = json.dumps(self._read()).encode()

@@ -1,61 +1,24 @@
 """Prometheus text exposition correctness for the in-repo metrics
 toolkit (kaito_tpu/engine/metrics.py): bucket monotonicity, +Inf ==
 _count, percentile edge cases, labelled-series semantics, and label
-escaping — plus a mini text-format parser run against a real sim
-engine's /metrics payload (slow tier)."""
+escaping — parsed with the promoted library parser
+(kaito_tpu/utils/promtext.py) and round-tripped against every registry
+in the codebase, plus a real sim engine's /metrics payload (slow
+tier)."""
 
 import math
-import re
 import threading
 
 import pytest
 
 from kaito_tpu.engine.metrics import Counter, Gauge, Histogram, Registry
+from kaito_tpu.utils.promtext import (check_histograms, parse_exposition,
+                                      parse_labels)
 
-# one full sample line: name, optional {labels}, value
-_SAMPLE_RE = re.compile(
-    r"^([A-Za-z_:][A-Za-z0-9_:]*)(\{.*\})? (-?(?:[0-9.]+(?:e[+-]?[0-9]+)?|inf|nan))$",
-    re.IGNORECASE)
-_LE_RE = re.compile(r'le="([^"]*)"')
-
-
-def _parse(text):
-    """Mini exposition parser: every non-comment line must be a valid
-    sample; returns [(name, labels_str, float_value)]."""
-    samples = []
-    for line in text.splitlines():
-        if not line.strip() or line.startswith("#"):
-            continue
-        m = _SAMPLE_RE.match(line)
-        assert m, f"unparseable exposition line: {line!r}"
-        samples.append((m.group(1), m.group(2) or "", float(m.group(3))))
-    return samples
-
-
-def _check_histograms(samples):
-    """For every histogram family present: cumulative buckets must be
-    monotone in le, and the +Inf bucket must equal _count."""
-    series = {}
-    for name, labels, value in samples:
-        if not name.endswith("_bucket"):
-            continue
-        le = _LE_RE.search(labels).group(1)
-        rest = _LE_RE.sub("", labels).replace(",}", "}").replace("{,", "{")
-        if rest == "{}":
-            rest = ""                          # unlabelled family
-        series.setdefault((name[:-len("_bucket")], rest), []).append(
-            (math.inf if le == "+Inf" else float(le), value))
-    assert series, "no histogram buckets in payload"
-    counts = {(n, lbl): v for n, lbl, v in samples if n.endswith("_count")}
-    for (fam, rest), buckets in series.items():
-        buckets.sort()
-        assert buckets[-1][0] == math.inf, f"{fam}: missing +Inf bucket"
-        values = [v for _, v in buckets]
-        assert values == sorted(values), f"{fam}{rest}: non-monotone"
-        count = counts.get((fam + "_count", rest))
-        assert count is not None, f"{fam}{rest}: missing _count"
-        assert buckets[-1][1] == count, f"{fam}{rest}: +Inf != _count"
-    return series
+# kept under the historical names: other suites (tests/test_epp.py)
+# import the parser from here
+_parse = parse_exposition
+_check_histograms = check_histograms
 
 
 def test_unlabelled_histogram_buckets_cumulative():
@@ -160,6 +123,54 @@ def test_histogram_thread_safety_smoke():
     by_line = {(n, lbl): v for n, lbl, v in samples}
     for tag in range(4):
         assert by_line[("t:mt_count", f'{{w="{tag}"}}')] == 500
+
+
+def test_parse_labels_unescapes():
+    assert parse_labels('{path="a\\\\b\\"c\\nd",le="+Inf"}') == \
+        {"path": 'a\\b"c\nd', "le": "+Inf"}
+    assert parse_labels("") == {}
+
+
+def test_every_registry_round_trips():
+    """One strict parse + histogram-invariant pass over every metrics
+    registry in the codebase, so a label-escaping or exposition
+    regression in ANY producer fails here (docs/observability.md)."""
+    from kaito_tpu.controllers.metrics import ManagerMetrics
+    from kaito_tpu.engine.metrics import EngineMetrics
+    from kaito_tpu.runtime.epp import EndpointPicker
+    from kaito_tpu.runtime.routing import RoutingCore
+
+    url = "http://127.0.0.1:9"
+    em = EngineMetrics()
+    em.ttft.observe(0.05)
+    em.request_success.inc(finished_reason="stop")
+
+    core = RoutingCore([url])
+    core.m_forwarded.inc(backend=url)
+    core.upstream_latency.observe(0.01, backend=url)
+
+    epp = EndpointPicker([url])
+    epp.m_forwarded.inc(backend=url)
+    epp.upstream_latency.observe(0.02, backend=url)
+
+    mm = ManagerMetrics()
+    mm.observe_reconcile("WorkspaceReconciler", "ok", 0.001)
+    mm.workspace_condition.set(1.0, name='ws"hairy\nname', type="Ready")
+
+    for tag, registry in (("engine", em.registry), ("router", core.registry),
+                          ("epp", epp.registry), ("manager", mm.registry)):
+        samples = parse_exposition(registry.expose())
+        assert samples, f"{tag}: empty payload"
+        check_histograms(samples)
+
+    # the tuning sidecar renders its exposition by hand — same parser
+    from kaito_tpu.tuning.metrics_server import render_metrics
+
+    samples = parse_exposition(render_metrics(
+        {"step": 3, "loss": 1.5, "tokens_per_second": 10.0}, done=True))
+    names = {n for n, _, _ in samples}
+    assert {"kaito:tuning_step", "kaito:tuning_loss",
+            "kaito:tuning_completed"} <= names
 
 
 @pytest.mark.slow
