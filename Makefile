@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: all native unit-test unit-test-fast unit-test-slow engine-test rag-test chaos kvq obs slo fleet spec bench serve manager epp clean
+.PHONY: all native unit-test unit-test-fast unit-test-slow engine-test rag-test chaos kvq obs slo fleet autoscale spec bench serve manager epp clean
 
 all: native
 
@@ -59,6 +59,13 @@ slo:
 # scraping — fast tier; the two-real-replica scrape e2e is the slow leg
 fleet:
 	$(PYTHON) -m pytest tests/test_fleet.py -q -m "not slow"
+
+# closed-loop autoscaler (docs/autoscaling.md): policy surface,
+# stabilization/cooldown/flap suppression, warm-pool render-ahead +
+# GC, EPP drain-before-delete — fast tier; the real-engine
+# idle→pressure→scale→zero→wake closed loop is the slow leg
+autoscale:
+	$(PYTHON) -m pytest tests/test_autoscaler.py -q -m "not slow"
 
 # speculative-decoding suite (docs/speculative.md): n-gram + draft
 # model paths — rejection sampler properties, adaptive-depth

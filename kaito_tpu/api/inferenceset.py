@@ -36,12 +36,65 @@ class WorkspaceTemplate:
 
 
 @dataclass
+class AutoscalePolicy:
+    """First-class autoscale surface consumed by the closed-loop
+    actuator (``controllers/autoscaler.py``).  The fleet telemetry
+    plane's hints (``SignalPolicy.scale_to_zero_hint`` /
+    ``max_replicas_hint``) are derived from the SAME fields so
+    ``status.recommended_replicas`` and actuation never disagree."""
+
+    enabled: bool = False
+    min_replicas: int = 1
+    max_replicas: int = 0               # 0 = bounded only by nodeCountLimit
+    scale_to_zero: bool = False         # sustained idle may park the set at 0
+    idle_grace_s: float = 600.0         # extra idle dwell before scale-down
+    scale_up_stabilization_s: float = 30.0
+    scale_down_stabilization_s: float = 300.0
+    scale_up_cooldown_s: float = 60.0
+    scale_down_cooldown_s: float = 300.0
+    drain_grace_s: float = 30.0         # EPP drain window before delete
+    warm_pool: int = 1                  # replicas provisioned ahead on pressure
+    warm_pool_gc_s: float = 600.0       # sustained non-pressure before warm GC
+
+    def default(self) -> None:
+        if self.min_replicas < 0:
+            self.min_replicas = 0
+        if self.max_replicas < 0:
+            self.max_replicas = 0
+        if self.warm_pool < 0:
+            self.warm_pool = 0
+        for f in ("idle_grace_s", "scale_up_stabilization_s",
+                  "scale_down_stabilization_s", "scale_up_cooldown_s",
+                  "scale_down_cooldown_s", "drain_grace_s",
+                  "warm_pool_gc_s"):
+            if getattr(self, f) < 0:
+                setattr(self, f, 0.0)
+
+    def validate(self) -> list[str]:
+        errs = []
+        if not self.enabled:
+            return errs
+        if self.min_replicas == 0 and not self.scale_to_zero:
+            errs.append("autoscale.minReplicas 0 requires "
+                        "autoscale.scaleToZero")
+        if self.max_replicas and self.max_replicas < max(1, self.min_replicas):
+            errs.append("autoscale.maxReplicas must be >= minReplicas")
+        return errs
+
+    def floor(self) -> int:
+        """Lowest replica count sustained idle may park the set at:
+        0 when scale-to-zero is on, else minReplicas (>= 1)."""
+        return 0 if self.scale_to_zero else max(1, self.min_replicas)
+
+
+@dataclass
 class InferenceSetSpec:
     replicas: int = 1
     template: WorkspaceTemplate = field(default_factory=WorkspaceTemplate)
     node_count_limit: int = 0           # 0 = unlimited
     update_strategy: str = "RollingUpdate"
     auto_upgrade: AutoUpgradePolicy = field(default_factory=AutoUpgradePolicy)
+    autoscale: AutoscalePolicy = field(default_factory=AutoscalePolicy)
 
 
 @dataclass
@@ -70,6 +123,7 @@ class InferenceSet(KaitoObject):
             self.spec.replicas = 0
         if not self.spec.update_strategy:
             self.spec.update_strategy = "RollingUpdate"
+        self.spec.autoscale.default()
 
     def validate(self) -> list[str]:
         errs = []
@@ -83,4 +137,5 @@ class InferenceSet(KaitoObject):
             errs.append("autoUpgrade.maintenanceWindow.cron required when enabled")
         if not self.spec.template.inference.preset and self.spec.template.inference.template is None:
             errs.append("template.inference.preset or template is required")
+        errs.extend(self.spec.autoscale.validate())
         return errs

@@ -35,6 +35,10 @@ COND_SCALING_SIGNAL = "ScalingSignal"
 # annotations / labels (our namespace, same roles as kaito.sh/*)
 ANNOTATION_DISABLE_BENCHMARK = "kaito-tpu.io/disable-benchmark"
 ANNOTATION_UPGRADE_TO = "kaito-tpu.io/upgrade-to-version"
+# scale-down victim mark (controllers/autoscaler.py): the EPP renders
+# this replica's backend as draining (picker stops scoring it,
+# in-flight requests finish) before the Workspace is deleted
+ANNOTATION_DRAINING = "kaito-tpu.io/draining"
 LABEL_WORKSPACE_NAME = "kaito-tpu.io/workspace"
 LABEL_CREATED_BY_INFERENCESET = "kaito-tpu.io/workspace-created-by-inferenceset"
 

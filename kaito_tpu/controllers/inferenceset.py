@@ -15,6 +15,7 @@ import logging
 from kaito_tpu.api.inferenceset import InferenceSet
 from kaito_tpu.api.meta import Condition, ObjectMeta, condition_true, set_condition
 from kaito_tpu.api.workspace import (
+    ANNOTATION_DRAINING,
     COND_INFERENCE_READY,
     LABEL_CREATED_BY_INFERENCESET,
     Workspace,
@@ -33,6 +34,27 @@ from kaito_tpu.k8s.events import record_event
 logger = logging.getLogger(__name__)
 
 COND_SET_READY = "InferenceSetReady"
+
+
+def make_child_workspace(iset: InferenceSet, index: int) -> Workspace:
+    """Render the index-th replica Workspace from the set's template.
+    Module-level so the autoscaler can plan the NEXT replica (warm-pool
+    provisioning needs its name and slice shape before it exists)."""
+    import copy
+
+    t = iset.spec.template
+    name = f"{iset.metadata.name}-{index}"
+    return Workspace(
+        ObjectMeta(
+            name=name, namespace=iset.metadata.namespace,
+            labels={**t.labels,
+                    LABEL_CREATED_BY_INFERENCESET: iset.metadata.name},
+            annotations=dict(t.annotations),
+            owner_references=[{"kind": "InferenceSet",
+                               "name": iset.metadata.name,
+                               "uid": iset.metadata.uid}]),
+        resource=copy.deepcopy(t.resource),
+        inference=copy.deepcopy(t.inference))
 
 
 class InferenceSetReconciler(Reconciler):
@@ -64,22 +86,31 @@ class InferenceSetReconciler(Reconciler):
             labels={LABEL_CREATED_BY_INFERENCESET: iset.metadata.name})
 
     def _make_child(self, iset: InferenceSet, index: int) -> Workspace:
-        import copy
+        return make_child_workspace(iset, index)
 
-        t = iset.spec.template
-        name = f"{iset.metadata.name}-{index}"
-        ws = Workspace(
-            ObjectMeta(
-                name=name, namespace=iset.metadata.namespace,
-                labels={**t.labels,
-                        LABEL_CREATED_BY_INFERENCESET: iset.metadata.name},
-                annotations=dict(t.annotations),
-                owner_references=[{"kind": "InferenceSet",
-                                   "name": iset.metadata.name,
-                                   "uid": iset.metadata.uid}]),
-            resource=copy.deepcopy(t.resource),
-            inference=copy.deepcopy(t.inference))
-        return ws
+    def _nodes_per_replica(self, iset: InferenceSet,
+                           children: list[Workspace]) -> int:
+        """Nodes one replica costs, for the nodeCountLimit guard.
+        Observed child status wins; with no children yet (scale from
+        zero) the template is planned instead — defaulting to 1 there
+        over-admitted multi-node presets exactly when the guard matters
+        most.  Planning failures fall back to 1 (the workspace
+        reconciler will surface PlanFailed on the child itself)."""
+        observed = [c.status.target_node_count for c in children
+                    if c.status.target_node_count > 0]
+        if observed:
+            return max(observed)
+        try:
+            from kaito_tpu.controllers.workspace import plan_workspace
+
+            ws = self._make_child(iset, 0)
+            _, plan, _ = plan_workspace(self.store, ws)
+            return max(1, plan.num_hosts * ws.resource.count)
+        except Exception:
+            logger.debug("template plan failed for %s; node guard "
+                         "assumes 1 node/replica", iset.metadata.name,
+                         exc_info=True)
+            return 1
 
     def reconcile(self, iset: InferenceSet) -> Result:
         if iset.metadata.deletion_timestamp:
@@ -103,18 +134,20 @@ class InferenceSetReconciler(Reconciler):
 
         # node-count guard (spec.nodeCountLimit)
         if iset.spec.node_count_limit:
-            per_replica = max((c.status.target_node_count for c in children),
-                              default=1) or 1
-            max_replicas = iset.spec.node_count_limit // per_replica
+            max_replicas = iset.spec.node_count_limit \
+                // self._nodes_per_replica(iset, children)
             want = min(want, max(max_replicas, 0))
 
         if len(children) < want:
             used = {c.metadata.name for c in children}
             creating = 0
-            for i in range(want * 2):
-                if len(children) + creating >= want:
-                    break
+            # probe indices unboundedly: scale-up/down churn leaves
+            # sparse index sets (e.g. {0, 3, 7}), so any fixed probe
+            # range can run out of fresh names before reaching want
+            i = 0
+            while len(children) + creating < want:
                 child = self._make_child(iset, i)
+                i += 1
                 if child.metadata.name in used:
                     continue
                 self.expectations.expect_creations(key, 1)
@@ -125,11 +158,15 @@ class InferenceSetReconciler(Reconciler):
                              f"created {creating} replica workspace(s) "
                              f"toward {want}")
         elif len(children) > want:
-            # delete not-ready first (reference: :222-247)
-            def readiness(ws):
-                return condition_true(ws.status.conditions, COND_INFERENCE_READY)
+            # delete draining-marked first (the autoscaler already
+            # flushed their traffic through the EPP), then not-ready
+            # (reference: :222-247)
+            def victim_order(ws):
+                return (not ws.metadata.annotations.get(ANNOTATION_DRAINING),
+                        condition_true(ws.status.conditions,
+                                       COND_INFERENCE_READY))
 
-            victims = sorted(children, key=readiness)[: len(children) - want]
+            victims = sorted(children, key=victim_order)[: len(children) - want]
             for v in victims:
                 self.expectations.expect_deletions(key, 1)
                 self.store.delete("Workspace", v.metadata.namespace,
@@ -194,10 +231,15 @@ class InferenceSetReconciler(Reconciler):
         from kaito_tpu.manifests.epp import EPP_PORT, generate_epp_workload
 
         ns = iset.metadata.namespace
+        children = self._children(iset)
         backends = sorted(f"http://{c.metadata.name}:{EPP_PORT}"
-                          for c in self._children(iset))
+                          for c in children)
+        draining = sorted(f"http://{c.metadata.name}:{EPP_PORT}"
+                          for c in children
+                          if c.metadata.annotations.get(ANNOTATION_DRAINING))
         objs = generate_epp_workload(
             f"{iset.metadata.name}-epp", ns, backends=backends,
+            draining=draining,
             owner={"kind": "InferenceSet", "name": iset.metadata.name})
         for obj in objs:
             existing = self.store.try_get(obj.kind, ns, obj.metadata.name)

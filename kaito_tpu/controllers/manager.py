@@ -73,6 +73,14 @@ class Manager:
         # construct — no threads or sockets until run()/start()
         self.fleet = FleetTelemetry(self.store)
         self.fleet.register_metrics(self.metrics.registry)
+        # the actuation half: consumes fleet signals, mutates replicas
+        self.autoscaler = None
+        if self.gates["autoscaler"]:
+            from kaito_tpu.controllers.autoscaler import AutoscalerController
+
+            self.autoscaler = AutoscalerController(self.store, self.fleet,
+                                                   self.provisioner)
+            self.autoscaler.register_metrics(self.metrics.registry)
 
         self._stop = threading.Event()
 
@@ -124,6 +132,20 @@ class Manager:
             self.fleet.apply_signals()
         except Exception:
             logger.exception("fleet telemetry pass failed")
+        if self.autoscaler is not None:
+            t0 = time.monotonic()
+            asc_result = "ok"
+            try:
+                with self.metrics.tracer.span(
+                        "reconcile.Autoscaler", "Autoscaler/cluster",
+                        controller="AutoscalerController"):
+                    self.autoscaler.tick()
+            except Exception:
+                asc_result = "error"
+                logger.exception("autoscaler pass failed")
+            self.metrics.observe_reconcile("AutoscalerController",
+                                           asc_result,
+                                           time.monotonic() - t0)
 
     def run(self, interval: float = 2.0) -> None:
         logger.info("manager running; gates=%s", self.gates)

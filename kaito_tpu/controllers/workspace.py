@@ -61,6 +61,62 @@ FINALIZER = "kaito-tpu.io/workspace-finalizer"
 BENCH_METRIC_PEAK_TPM = "peakTokensPerMinute"
 
 
+def plan_workspace(store: Store, ws: Workspace):
+    """Preset + instance type -> (model metadata, ParallelPlan,
+    TPUSliceSpec).  Module-level so consumers that plan a Workspace
+    that does not exist yet — the InferenceSet node-count guard and
+    the autoscaler's warm-pool render — share one decision with the
+    reconcile path instead of re-deriving capacity math."""
+    md = get_model_by_name(ws.preset_name)
+    entry = MACHINE_TYPES.get(ws.resource.instance_type)
+    if entry is not None:
+        chip = CHIP_CATALOG[entry[0]]
+    else:
+        # BYO path: derive chip from an existing labeled node
+        spec = None
+        for n in store.list("Node", labels=ws.resource.label_selector or None):
+            spec = get_tpu_config_from_node_labels(n.metadata.labels)
+            if spec:
+                break
+        if spec is None:
+            raise ValueError(
+                f"cannot determine TPU generation for {ws.metadata.name}: "
+                f"unknown instance type and no labeled BYO nodes")
+        chip = spec.chip
+    workload = "train" if ws.tuning is not None else "serve"
+    target = None
+    if ws.resource.tpu_topology:
+        from kaito_tpu.sku.catalog import topology_chips
+
+        target = topology_chips(ws.resource.tpu_topology)
+    # an int8 KV pool halves bytes/token, so the planner can fit the
+    # same context on fewer chips (estimator threads the byte width
+    # through kv_bytes_per_token)
+    kv_dtype = ws.metadata.annotations.get(
+        "kaito-tpu.io/kv-cache-dtype", "")
+    # speculative-draft pairing fails the plan (PlanFailed
+    # condition + event) when the named draft is unknown or shares
+    # no tokenizer with the target — before any capacity is asked
+    # for (docs/speculative.md)
+    from kaito_tpu.models.registry import resolve_speculative_draft
+    resolve_speculative_draft(md, ws.metadata.annotations.get(
+        "kaito-tpu.io/speculative-draft", ""))
+    # CP prefill auto-carve is evidence-gated (plan_parallelism
+    # docstring: BENCH_r05 cp_speedup 0.68 < 1.0) — serve plans
+    # only carve a sequence axis when the user opts in
+    cp_opt_in = ws.metadata.annotations.get(
+        "kaito-tpu.io/cp-autocarve", "") == "true"
+    plan = plan_parallelism(md, chip, workload=workload,
+                            target_chips=target,
+                            kv_dtype_bytes=1 if kv_dtype == "int8" else 2,
+                            cp_autocarve=cp_opt_in)
+    slice_spec = TPUSliceSpec(
+        chip=chip, topology=plan.topology,
+        machine_type=ws.resource.instance_type
+        if ws.resource.instance_type in MACHINE_TYPES else "")
+    return md, plan, slice_spec
+
+
 class WorkspaceReconciler(Reconciler):
     kind = "Workspace"
 
@@ -173,54 +229,7 @@ class WorkspaceReconciler(Reconciler):
     # ------------------------------------------------------------------
 
     def _plan(self, ws: Workspace):
-        md = get_model_by_name(ws.preset_name)
-        entry = MACHINE_TYPES.get(ws.resource.instance_type)
-        if entry is not None:
-            chip = CHIP_CATALOG[entry[0]]
-        else:
-            # BYO path: derive chip from an existing labeled node
-            spec = None
-            for n in self.store.list("Node", labels=ws.resource.label_selector or None):
-                spec = get_tpu_config_from_node_labels(n.metadata.labels)
-                if spec:
-                    break
-            if spec is None:
-                raise ValueError(
-                    f"cannot determine TPU generation for {ws.metadata.name}: "
-                    f"unknown instance type and no labeled BYO nodes")
-            chip = spec.chip
-        workload = "train" if ws.tuning is not None else "serve"
-        target = None
-        if ws.resource.tpu_topology:
-            from kaito_tpu.sku.catalog import topology_chips
-
-            target = topology_chips(ws.resource.tpu_topology)
-        # an int8 KV pool halves bytes/token, so the planner can fit the
-        # same context on fewer chips (estimator threads the byte width
-        # through kv_bytes_per_token)
-        kv_dtype = ws.metadata.annotations.get(
-            "kaito-tpu.io/kv-cache-dtype", "")
-        # speculative-draft pairing fails the plan (PlanFailed
-        # condition + event) when the named draft is unknown or shares
-        # no tokenizer with the target — before any capacity is asked
-        # for (docs/speculative.md)
-        from kaito_tpu.models.registry import resolve_speculative_draft
-        resolve_speculative_draft(md, ws.metadata.annotations.get(
-            "kaito-tpu.io/speculative-draft", ""))
-        # CP prefill auto-carve is evidence-gated (plan_parallelism
-        # docstring: BENCH_r05 cp_speedup 0.68 < 1.0) — serve plans
-        # only carve a sequence axis when the user opts in
-        cp_opt_in = ws.metadata.annotations.get(
-            "kaito-tpu.io/cp-autocarve", "") == "true"
-        plan = plan_parallelism(md, chip, workload=workload,
-                                target_chips=target,
-                                kv_dtype_bytes=1 if kv_dtype == "int8" else 2,
-                                cp_autocarve=cp_opt_in)
-        slice_spec = TPUSliceSpec(
-            chip=chip, topology=plan.topology,
-            machine_type=ws.resource.instance_type
-            if ws.resource.instance_type in MACHINE_TYPES else "")
-        return md, plan, slice_spec
+        return plan_workspace(self.store, ws)
 
     def _ensure_model_mirror(self, md) -> bool:
         name = md.name.replace("/", "-")

@@ -14,6 +14,7 @@ DEFAULT_GATES: dict[str, bool] = {
     "modelMirror": False,
     "modelStreaming": False,
     "enableBaseImageAutoUpgrade": False,
+    "autoscaler": False,
     "pallasAttention": True,
     "sequenceParallelism": True,
 }

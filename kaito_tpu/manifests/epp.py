@@ -25,13 +25,18 @@ LABEL_EPP = "kaito-tpu.io/epp"
 
 def build_epp_command(backends: list[str], *,
                       plugins_config: Optional[dict] = None,
-                      block_chars: int = 0) -> list[str]:
+                      block_chars: int = 0,
+                      draining: Optional[list[str]] = None) -> list[str]:
     """The container command: one ``--backend`` per replica spec
-    (``url[=role[/group]]``), the plugin chain inline as JSON."""
+    (``url[=role[/group]]``), the plugin chain inline as JSON, and one
+    ``--drain-backend`` per replica the autoscaler is retiring (the
+    picker keeps relaying its in-flight work but stops scoring it)."""
     cmd = ["python", "-m", "kaito_tpu.runtime.epp",
            "--port", str(EPP_PORT)]
     for spec in backends:
         cmd += ["--backend", spec]
+    for url in draining or []:
+        cmd += ["--drain-backend", url]
     if plugins_config:
         cmd += ["--plugins-config",
                 json.dumps(plugins_config, sort_keys=True)]
@@ -44,6 +49,7 @@ def generate_epp_workload(name: str, namespace: str, *,
                           backends: list[str],
                           owner: Optional[dict] = None,
                           plugins_config: Optional[dict] = None,
+                          draining: Optional[list[str]] = None,
                           image: str = DEFAULT_IMAGE) -> list:
     """Render the ``<name>`` (conventionally ``<cr>-epp``) Deployment +
     Service the InferencePool's extensionRef resolves to."""
@@ -63,7 +69,8 @@ def generate_epp_workload(name: str, namespace: str, *,
                         "name": "epp",
                         "image": image,
                         "command": build_epp_command(
-                            backends, plugins_config=plugins_config),
+                            backends, plugins_config=plugins_config,
+                            draining=draining),
                         "ports": [{"containerPort": EPP_PORT}],
                         "readinessProbe": {
                             "httpGet": {"path": "/router/stats",

@@ -130,8 +130,15 @@ class EndpointPicker(RoutingCore):
     def __init__(self, backends: list, *, block_chars: int = 0,
                  index_capacity: int = 65536,
                  plugins_config: Optional[dict] = None,
-                 registry: Optional[Registry] = None):
-        super().__init__(backends, registry)
+                 registry: Optional[Registry] = None,
+                 draining: Optional[Iterable[str]] = None):
+        # empty pools are legal here: a scaled-to-zero InferenceSet
+        # keeps its EPP front alive so arrivals surface as
+        # kaito:router_requests_received_total (the wake signal) while
+        # clients get a retryable 503 instead of a dead DNS name
+        super().__init__(backends, registry, allow_empty=True)
+        for url in draining or ():
+            self.set_draining(url)
         self._block_chars = block_chars        # 0 = auto from kv_page_size
         self.index = PrefixAffinityIndex(index_capacity)
         cfg = plugins_config or default_epp_plugins_config()
@@ -247,17 +254,19 @@ class EndpointPicker(RoutingCore):
 
     def candidates(self, method: str, path: str,
                    ctx) -> Iterable[Backend]:
-        """Alive candidates in descending score order, then cooling-down
-        backends as a last resort (same never-0-candidates guarantee as
-        the round-robin front)."""
+        """Alive candidates in descending score order, then draining
+        backends (healthy but leaving the pool — 503-free last resort),
+        then cooling-down backends (same never-0-candidates guarantee
+        as the round-robin front)."""
         if not isinstance(ctx, RequestCtx):
             ctx = RequestCtx()
         pool = self._filter_role(ctx, list(self.backends))
-        alive = [b for b in pool if b.alive]
+        alive = [b for b in pool if b.alive and not b.draining]
+        draining = [b for b in pool if b.alive and b.draining]
         dead = [b for b in pool if not b.alive]
         # stable sort: score ties fall back to least-loaded-first order
         alive.sort(key=lambda b: (-self._score(b, ctx), b.load.waiting))
-        for b in alive + dead:
+        for b in alive + draining + dead:
             with self._lock:
                 b.served += 1
             yield b
@@ -280,7 +289,10 @@ class EndpointPicker(RoutingCore):
                 self.m_affinity_hits.inc()
             else:
                 self.m_affinity_misses.inc()
-            if status < 500:
+            # a draining replica's KV is about to be torn down: never
+            # record fresh affinity that would steer prompts at a
+            # backend scheduled for deletion
+            if status < 500 and not backend.draining:
                 self.index.record(ctx.blocks, backend.url)
 
 
@@ -293,9 +305,15 @@ def _parse_backend_arg(spec: str) -> Backend:
 
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="kaito-tpu-epp")
-    ap.add_argument("--backend", action="append", required=True,
+    ap.add_argument("--backend", action="append", default=[],
                     help="backend spec url[=role[/group]] (repeat per "
-                         "replica); role in {prefill,decode,both}")
+                         "replica); role in {prefill,decode,both}; zero "
+                         "backends = scaled-to-zero front (503 + wake "
+                         "signal)")
+    ap.add_argument("--drain-backend", action="append", default=[],
+                    help="backend url currently draining for scale-down "
+                         "(kept serving in-flight work, never scored for "
+                         "new picks)")
     ap.add_argument("--port", type=int, default=5000)
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--block-chars", type=int, default=0,
@@ -329,7 +347,8 @@ def main(argv=None):
         [_parse_backend_arg(s) for s in args.backend],
         block_chars=args.block_chars,
         index_capacity=args.index_capacity,
-        plugins_config=plugins_config)
+        plugins_config=plugins_config,
+        draining=args.drain_backend)
     srv = make_routing_server(picker, args.host, args.port,
                               probe_interval_s=args.health_probe_interval_s,
                               scrape_interval_s=args.scrape_interval_s)

@@ -92,10 +92,13 @@ _ENGINE_COUNTERS = {
     "kaito:spec_proposed_tokens_total": "spec_proposed_total",
     "kaito:spec_accepted_tokens_total": "spec_accepted_total",
 }
-# EPP / router front series (arrival side of the same CR)
+# EPP / router front series (arrival side of the same CR).  The
+# received counter keeps ticking even with ZERO backends — it is the
+# scale-to-zero wake signal the autoscaler watches.
 _EPP_COUNTERS = {
     "kaito:router_requests_forwarded_total": "forwarded_total",
     "kaito:epp_requests_forwarded_total": "forwarded_total",
+    "kaito:router_requests_received_total": "received_total",
 }
 
 
@@ -335,6 +338,10 @@ class _CRSeries:
         self.transitions = 0
         self.last_decision: Optional[SignalDecision] = None
         self.replicas_desired = 0
+        # per-CR hint overrides from spec.autoscale (scale_to_zero,
+        # max_replicas); None = global policy (one config source for
+        # recommended_replicas hints AND actuation)
+        self.hint_overrides: Optional[tuple[bool, int]] = None
 
     def add(self, agg: dict) -> None:
         now = self.time_fn()
@@ -462,6 +469,7 @@ class FleetTelemetry:
 
         targets: dict[tuple, dict[str, ScrapeTarget]] = {}
         desired: dict[tuple, int] = {}
+        hints: dict[tuple, tuple[bool, int]] = {}
 
         def add(key, url, replica, role):
             if url is None:
@@ -479,6 +487,10 @@ class FleetTelemetry:
             key = ("InferenceSet", ns, name)
             desired[key] = max(getattr(iset.status, "replicas", 0),
                                getattr(iset.spec, "replicas", 0))
+            autoscale = getattr(iset.spec, "autoscale", None)
+            if autoscale is not None and autoscale.enabled:
+                hints[key] = (bool(autoscale.scale_to_zero),
+                              int(autoscale.max_replicas))
             children = self.store.list(
                 "Workspace", ns,
                 labels={LABEL_CREATED_BY_INFERENCESET: name})
@@ -517,6 +529,7 @@ class FleetTelemetry:
                         key[0], key[1], key[2], self.max_window_s,
                         self.time_fn)
                 cr.replicas_desired = desired.get(key, len(tmap))
+                cr.hint_overrides = hints.get(key)
                 smap = self._samples.setdefault(key, {})
                 for url in list(smap):
                     if url not in tmap:
@@ -593,7 +606,7 @@ class FleetTelemetry:
         for key in ("requests_total", "shed_total", "gen_tokens_total",
                     "prefix_hits_total", "prefix_misses_total",
                     "spec_proposed_total", "spec_accepted_total",
-                    "forwarded_total"):
+                    "forwarded_total", "received_total"):
             if key not in values or key not in prev.values:
                 continue
             delta = values[key] - prev.values[key]
@@ -737,6 +750,8 @@ class FleetTelemetry:
         if epps:
             agg["arrival_rate"] = sum(
                 s.rates.get("forwarded_rate", 0.0) for s in epps)
+            agg["received_rate"] = sum(
+                s.rates.get("received_rate", 0.0) for s in epps)
             agg["epp_reporting"] = float(len(epps))
         return agg
 
@@ -754,7 +769,18 @@ class FleetTelemetry:
             samples = list(cr.samples)
             prev = cr.state
             replicas = cr.replicas_desired or 1
-        decision = evaluate_signal(prev, samples, self.policy,
+            overrides = cr.hint_overrides
+        policy = self.policy
+        if overrides is not None:
+            # spec.autoscale is the single config source: its
+            # scale-to-zero / max-replicas bounds shape the hint the
+            # actuator consumes (satellite of the autoscaler PR)
+            import dataclasses
+
+            policy = dataclasses.replace(
+                policy, scale_to_zero_hint=overrides[0],
+                max_replicas_hint=overrides[1])
+        decision = evaluate_signal(prev, samples, policy,
                                    self.time_fn(), replicas)
         with self._lock:
             if decision.state != cr.state:
@@ -763,6 +789,23 @@ class FleetTelemetry:
                 cr.transitions += 1
             cr.last_decision = decision
         return decision
+
+    def signal(self, key: tuple) -> Optional[tuple[str, float, SignalDecision]]:
+        """Actuator-facing read: (state, state_since, last decision)
+        for one CR, or None before the first evaluation.  The
+        autoscaler consumes this instead of re-parsing conditions."""
+        with self._lock:
+            cr = self._crs.get(key)
+            if cr is None or cr.last_decision is None:
+                return None
+            return cr.state, cr.state_since, cr.last_decision
+
+    def last_aggregate(self, key: tuple) -> dict:
+        """Last folded aggregate for one CR ({} when never folded) —
+        the autoscaler's scale-to-zero wake check reads
+        ``received_rate`` from here."""
+        with self._lock:
+            return dict(self._last_agg.get(key, {}))
 
     def apply_signals(self) -> None:
         """Evaluate every CR and surface the verdict: ``ScalingSignal``

@@ -119,6 +119,10 @@ class Backend:
         self.failures = 0
         self.load = BackendLoad()
         self.saturated = False     # hysteresis state (update_saturation)
+        # scale-down drain (autoscaler): a draining backend keeps
+        # serving its in-flight work but stops attracting new picks —
+        # fronts order it after every healthy peer, never 503 it
+        self.draining = False
 
     @property
     def alive(self) -> bool:
@@ -477,8 +481,9 @@ class RoutingCore:
     Fronts override ``candidates`` (the ordering policy) and optionally
     ``make_ctx`` / ``note_response`` / ``handle_local``."""
 
-    def __init__(self, backends: list, registry: Optional[Registry] = None):
-        if not backends:
+    def __init__(self, backends: list, registry: Optional[Registry] = None,
+                 allow_empty: bool = False):
+        if not backends and not allow_empty:
             raise ValueError("router needs at least one backend")
         self.backends = [b if isinstance(b, Backend) else Backend(b)
                          for b in backends]
@@ -490,6 +495,10 @@ class RoutingCore:
         # engine replicas each expose theirs; these cover the transport
         r = registry if registry is not None else Registry()
         self.registry = r
+        self.m_received = Counter(
+            "kaito:router_requests_received_total",
+            "Relayable requests accepted by this front (scale-to-zero "
+            "wake signal: arrivals exist even with zero backends)", r)
         self.m_forwarded = Counter(
             "kaito:router_requests_forwarded_total",
             "Requests relayed to a backend (response head received)",
@@ -515,14 +524,30 @@ class RoutingCore:
               r, labels=("backend",),
               fn=lambda: {(b.url,): _BREAKER_STATES[b.state]
                           for b in self.backends})
+        Gauge("kaito:router_backend_draining",
+              "Scale-down drain state per backend (1 = not scored)",
+              r, labels=("backend",),
+              fn=lambda: {(b.url,): float(b.draining)
+                          for b in self.backends})
 
     # -- selection policy --------------------------------------------------
     def next_backend(self) -> Optional[Backend]:
-        """Next live backend (round robin), or the next one regardless
-        if every backend is cooling down (better a refused retry than a
-        guaranteed 503 when all marks are stale)."""
+        """Next live non-draining backend (round robin); draining
+        backends are last-resort only (they still serve correctly —
+        better that than a 503 — but new work prefers survivors), and
+        if every backend is cooling down, the next one regardless
+        (better a refused retry than a guaranteed 503 when all marks
+        are stale)."""
         with self._lock:
             n = len(self.backends)
+            if n == 0:
+                return None
+            for offset in range(n):
+                b = self.backends[(self._rr + offset) % n]
+                if b.alive and not b.draining:
+                    self._rr = (self._rr + offset + 1) % n
+                    b.served += 1
+                    return b
             for offset in range(n):
                 b = self.backends[(self._rr + offset) % n]
                 if b.alive:
@@ -533,6 +558,18 @@ class RoutingCore:
             self._rr = (self._rr + 1) % n
             b.served += 1
             return b
+
+    def set_draining(self, url: str, draining: bool = True) -> bool:
+        """Flip one backend's drain state (autoscaler scale-down:
+        mark, let in-flight finish, then remove).  Returns False when
+        no backend matches the url."""
+        url = url.rstrip("/")
+        found = False
+        for b in self.backends:
+            if b.url == url:
+                b.draining = draining
+                found = True
+        return found
 
     def make_ctx(self, method: str, path: str,
                  body: Optional[bytes]):
@@ -567,7 +604,8 @@ class RoutingCore:
     def stats(self) -> dict:
         with self._lock:
             return {b.url: {"served": b.served, "alive": b.alive,
-                            "state": b.state, "failures": b.failures}
+                            "state": b.state, "failures": b.failures,
+                            "draining": b.draining}
                     for b in self.backends}
 
     # -- drain bookkeeping -------------------------------------------------
@@ -671,6 +709,7 @@ def make_routing_server(core: RoutingCore, host: str = "0.0.0.0",
                 self._send_json(503, {"error": "router draining"},
                                 headers={"Retry-After": 1})
                 return
+            core.m_received.inc()
             try:
                 self._relay_inner(method)
             finally:
