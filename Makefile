@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: all native unit-test unit-test-fast unit-test-slow engine-test rag-test chaos kvq obs slo fleet autoscale spec bench serve manager epp clean
+.PHONY: all native unit-test unit-test-fast unit-test-slow engine-test rag-test chaos kvq obs slo fleet autoscale spec qos bench serve manager epp clean
 
 all: native
 
@@ -66,6 +66,13 @@ fleet:
 # idle→pressure→scale→zero→wake closed loop is the slow leg
 autoscale:
 	$(PYTHON) -m pytest tests/test_autoscaler.py -q -m "not slow"
+
+# multi-tenant QoS suite (docs/qos.md): config parsing, weighted-fair
+# DRR admission, priority-aware preemption, per-tenant budgets/metric
+# slices, EPP scorers, 429-aware fail-over — fast tier; the two-tenant
+# overload e2e over real engine processes is the slow leg
+qos:
+	$(PYTHON) -m pytest tests/test_qos.py -q -m "not slow"
 
 # speculative-decoding suite (docs/speculative.md): n-gram + draft
 # model paths — rejection sampler properties, adaptive-depth

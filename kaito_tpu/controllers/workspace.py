@@ -101,6 +101,15 @@ def plan_workspace(store: Store, ws: Workspace):
     from kaito_tpu.models.registry import resolve_speculative_draft
     resolve_speculative_draft(md, ws.metadata.annotations.get(
         "kaito-tpu.io/speculative-draft", ""))
+    # a malformed QoS document fails the plan (PlanFailed condition +
+    # event) before any capacity is asked for, instead of crash-looping
+    # the engine pod at startup (docs/qos.md)
+    from kaito_tpu.engine.qos import parse_qos_config
+    try:
+        parse_qos_config(ws.metadata.annotations.get(
+            "kaito-tpu.io/qos", ""))
+    except ValueError as e:
+        raise ValueError(f"invalid kaito-tpu.io/qos annotation: {e}")
     # CP prefill auto-carve is evidence-gated (plan_parallelism
     # docstring: BENCH_r05 cp_speedup 0.68 < 1.0) — serve plans
     # only carve a sequence axis when the user opts in

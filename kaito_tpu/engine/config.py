@@ -92,6 +92,11 @@ class EngineConfig:
     pd_enabled: bool = False             # P/D side-channel routes (MRI roles)
     pd_source_allowlist: str = ""        # comma URL prefixes for KV pulls
     max_queue_len: int = 256
+    # multi-tenant QoS (docs/qos.md): JSON tenant-class document
+    # (inline, or @path to a file) parsed by engine.qos.  "" = off —
+    # one implicit tenant, legacy FIFO admission and
+    # newest-preempts-first eviction, byte-identical exposition.
+    qos_config: str = ""
     # failure-domain isolation (docs/failure-domains.md)
     request_timeout_s: float = 0.0       # server-default deadline (0 = off);
     # clients may tighten per request via the body's "timeout" field

@@ -132,8 +132,16 @@ class DataParallelEngine:
         return agg
 
     @property
+    def qos(self):
+        # all groups parsed the same cfg.qos_config
+        return self.engines[0].qos
+
+    @property
     def num_waiting(self) -> int:
         return sum(e.num_waiting for e in self.engines)
+
+    def num_waiting_for(self, tenant: str) -> int:
+        return sum(e.num_waiting_for(tenant) for e in self.engines)
 
     @property
     def num_running(self) -> int:
@@ -153,13 +161,15 @@ class DataParallelEngine:
                req_id: Optional[str] = None, export_kv: bool = False,
                adapter: str = "",
                timeout_s: Optional[float] = None,
-               trace_id: Optional[str] = None) -> Request:
+               trace_id: Optional[str] = None,
+               tenant: str = "", priority: str = "") -> Request:
         if export_kv:
             raise RuntimeError("P/D KV export requires data_parallel=1")
         eng = self._pick()
         req = eng.submit(prompt_tokens, params, req_id=req_id,
                          adapter=adapter, timeout_s=timeout_s,
-                         trace_id=trace_id)
+                         trace_id=trace_id, tenant=tenant,
+                         priority=priority)
         req._dp_group = eng
         return req
 

@@ -130,6 +130,11 @@ class Request:
     # a client-supplied id can never collide with engine-internal keys
     # (kv_exports, host_kv); defaults to req_id at submit
     trace_id: str = ""
+    # multi-tenant QoS (docs/qos.md): tenant identity + resolved class
+    # priority.  Both stay at their zero values when QoS is off, so
+    # the scheduler's legacy single-FIFO behavior is untouched.
+    tenant: str = ""
+    priority: int = 0
 
     @property
     def expired(self) -> bool:
@@ -437,6 +442,17 @@ class InferenceEngine:
         self._decode_since_prefill = 0
         self._prefill_rr = 0
         self._admit_seq = 0
+        # multi-tenant QoS (docs/qos.md): None keeps the legacy single
+        # FIFO + newest-preempts-first behavior bit-for-bit.  With a
+        # config, admission becomes strict-priority across classes and
+        # deficit-round-robin across tenants within a class, and
+        # preemption evicts the lowest-priority newest sequence.
+        from kaito_tpu.engine.qos import parse_qos_config
+
+        self.qos = parse_qos_config(getattr(cfg, "qos_config", ""))
+        self._tenant_queues: dict[str, "collections.deque[Request]"] = {}
+        self._drr_order: dict[int, "collections.deque[str]"] = {}
+        self._drr_deficit: dict[str, float] = {}
 
         # metrics (scraped by the server's /metrics)
         self.counters = {
@@ -1198,25 +1214,46 @@ class InferenceEngine:
         t = timeout_s if timeout_s else self.cfg.request_timeout_s
         return (time.monotonic() + float(t)) if t else None
 
+    def _resolve_qos(self, tenant: str, priority: str) -> tuple[str, int]:
+        """(tenant id, numeric class priority) for a submission.  With
+        QoS off, the tenant rides along for tracing only and priority
+        stays 0 (the scheduler never reads either)."""
+        if self.qos is None:
+            return tenant or "", 0
+        from kaito_tpu.engine.qos import DEFAULT_TENANT
+
+        t = tenant or DEFAULT_TENANT
+        return t, self.qos.class_of(t, priority).priority
+
+    def _enqueue(self, req: Request) -> None:
+        """Queue a validated request for admission (all submit paths)."""
+        with self._lock:
+            self.counters["requests_total"] += 1
+            self._waiting_count += 1
+            if self.qos is None:
+                self.waiting.append(req)
+            else:
+                self._qos_push_locked(req)
+        self._wake.set()
+
     def submit(self, prompt_tokens: list[int], params: SamplingParams,
                req_id: Optional[str] = None,
                export_kv: bool = False, adapter: str = "",
                timeout_s: Optional[float] = None,
-               trace_id: Optional[str] = None) -> Request:
+               trace_id: Optional[str] = None,
+               tenant: str = "", priority: str = "") -> Request:
         self._validate_submit(prompt_tokens, params)
         if adapter and adapter not in self.adapter_index:
             raise ValueError(f"unknown adapter {adapter!r}")
         rid = req_id or f"req-{self.counters['requests_total']}"
+        t, prio = self._resolve_qos(tenant, priority)
         req = Request(rid,
                       list(prompt_tokens), params, export_kv=export_kv,
                       adapter=adapter,
                       deadline=self._deadline_for(timeout_s),
-                      trace_id=trace_id or rid)
-        with self._lock:
-            self.counters["requests_total"] += 1
-            self._waiting_count += 1
-            self.waiting.append(req)
-        self._wake.set()
+                      trace_id=trace_id or rid,
+                      tenant=t, priority=prio)
+        self._enqueue(req)
         return req
 
     def submit_with_kv(self, prompt_tokens: list[int], first_token: int,
@@ -1224,22 +1261,21 @@ class InferenceEngine:
                        params: SamplingParams,
                        req_id: Optional[str] = None,
                        timeout_s: Optional[float] = None,
-                       trace_id: Optional[str] = None) -> Request:
+                       trace_id: Optional[str] = None,
+                       tenant: str = "", priority: str = "") -> Request:
         """Decode-role entry: continue a prefilled request from
         transferred KV pages."""
         self._validate_submit(prompt_tokens, params)
         self._validate_kv_meta(meta, len(prompt_tokens))
         rid = req_id or f"pd-{self.counters['requests_total']}"
+        t, prio = self._resolve_qos(tenant, priority)
         req = Request(rid,
                       list(prompt_tokens), params,
                       kv_import=(meta, payload, first_token),
                       deadline=self._deadline_for(timeout_s),
-                      trace_id=trace_id or meta.get("trace_id") or rid)
-        with self._lock:
-            self.counters["requests_total"] += 1
-            self._waiting_count += 1
-            self.waiting.append(req)
-        self._wake.set()
+                      trace_id=trace_id or meta.get("trace_id") or rid,
+                      tenant=t, priority=prio)
+        self._enqueue(req)
         return req
 
     def submit_with_kv_device(self, prompt_tokens: list[int],
@@ -1247,7 +1283,9 @@ class InferenceEngine:
                               params: SamplingParams,
                               req_id: Optional[str] = None,
                               timeout_s: Optional[float] = None,
-                              trace_id: Optional[str] = None) -> Request:
+                              trace_id: Optional[str] = None,
+                              tenant: str = "",
+                              priority: str = "") -> Request:
         """Colocated decode entry: the prefill engine lives in THIS
         process, so its staged canonical KV slab hands off as a single
         device-to-device scatter — no host bounce, no wire (the
@@ -1262,16 +1300,14 @@ class InferenceEngine:
         # the page counts happen to match)
         self._validate_kv_meta(meta, len(prompt_tokens), strict_shape=True)
         rid = req_id or f"pd-{self.counters['requests_total']}"
+        t, prio = self._resolve_qos(tenant, priority)
         req = Request(rid,
                       list(prompt_tokens), params,
                       kv_device=(meta, slabs, first_token),
                       deadline=self._deadline_for(timeout_s),
-                      trace_id=trace_id or meta.get("trace_id") or rid)
-        with self._lock:
-            self.counters["requests_total"] += 1
-            self._waiting_count += 1
-            self.waiting.append(req)
-        self._wake.set()
+                      trace_id=trace_id or meta.get("trace_id") or rid,
+                      tenant=t, priority=prio)
+        self._enqueue(req)
         return req
 
     def submit_with_kv_chunked(self, prompt_tokens: list[int],
@@ -1280,7 +1316,8 @@ class InferenceEngine:
                                req_id: Optional[str] = None,
                                deadline_s: float = 120.0,
                                timeout_s: Optional[float] = None,
-                               trace_id: Optional[str] = None):
+                               trace_id: Optional[str] = None,
+                               tenant: str = "", priority: str = ""):
         """Decode-role entry for the CHUNKED transfer path: the request
         is admitted immediately and its KV chunks are scattered by the
         scheduler loop as the caller ``feed``s them into the returned
@@ -1292,18 +1329,16 @@ class InferenceEngine:
         self._validate_submit(prompt_tokens, params)
         self._validate_kv_meta(meta, len(prompt_tokens))
         rid = req_id or f"pd-{self.counters['requests_total']}"
+        t, prio = self._resolve_qos(tenant, priority)
         req = Request(rid,
                       list(prompt_tokens), params,
                       kv_chunked=ChunkedImport(meta, list(plans), first_token,
                                                deadline_s=deadline_s),
                       deadline=self._deadline_for(timeout_s),
                       kv_retries=max(0, self.cfg.kv_import_retries),
-                      trace_id=trace_id or meta.get("trace_id") or rid)
-        with self._lock:
-            self.counters["requests_total"] += 1
-            self._waiting_count += 1
-            self.waiting.append(req)
-        self._wake.set()
+                      trace_id=trace_id or meta.get("trace_id") or rid,
+                      tenant=t, priority=prio)
+        self._enqueue(req)
         return req
 
     def abort(self, req: Request) -> None:
@@ -1369,6 +1404,8 @@ class InferenceEngine:
 
     def _pop_waiting(self) -> Optional[Request]:
         with self._lock:
+            if self.qos is not None:
+                return self._qos_pop_locked()
             if not self.waiting:
                 return None
             self._waiting_count -= 1
@@ -1377,7 +1414,82 @@ class InferenceEngine:
     def _requeue_front(self, req: Request):
         with self._lock:
             self._waiting_count += 1
-            self.waiting.appendleft(req)
+            if self.qos is None:
+                self.waiting.appendleft(req)
+            else:
+                self._qos_push_locked(req, front=True)
+
+    # -- QoS admission queues (docs/qos.md) ----------------------------
+    #
+    # Per-tenant deques behind the same num_waiting/_pop/_requeue
+    # surface: admission pops strict-priority across classes and
+    # deficit-round-robin across tenants within a class, so one noisy
+    # tenant can neither starve a guaranteed class nor crowd out its
+    # own-priority peers beyond its weight.  All helpers assume
+    # self._lock is held.
+
+    def _qos_push_locked(self, req: Request, front: bool = False) -> None:
+        q = self._tenant_queues.get(req.tenant)
+        if q is None:
+            q = self._tenant_queues[req.tenant] = collections.deque()
+        order = self._drr_order.setdefault(req.priority,
+                                           collections.deque())
+        if front:
+            q.appendleft(req)
+            if req.tenant in order:
+                order.remove(req.tenant)
+            order.appendleft(req.tenant)
+            # a preempted resume must not wait out a DRR rotation: top
+            # the tenant's deficit up to one service
+            self._drr_deficit[req.tenant] = max(
+                self._drr_deficit.get(req.tenant, 0.0), 1.0)
+        else:
+            q.append(req)
+            if req.tenant not in order:
+                order.append(req.tenant)
+
+    def _qos_pop_locked(self) -> Optional[Request]:
+        for prio in sorted(self._drr_order, reverse=True):
+            order = self._drr_order[prio]
+            # every full rotation grants each tenant its weight of
+            # deficit (weight >= 1), so two passes guarantee a service
+            for _ in range(2 * len(order) + 1):
+                if not order:
+                    break
+                t = order[0]
+                q = self._tenant_queues.get(t)
+                if not q:
+                    # emptied by an expiry/fail-all sweep
+                    order.popleft()
+                    self._drr_deficit.pop(t, None)
+                    continue
+                if self._drr_deficit.get(t, 0.0) < 1.0:
+                    self._drr_deficit[t] = (self._drr_deficit.get(t, 0.0)
+                                            + self.qos.weight_of(t))
+                    order.rotate(-1)
+                    continue
+                self._drr_deficit[t] -= 1.0
+                req = q.popleft()
+                self._waiting_count -= 1
+                if not q:
+                    del self._tenant_queues[t]
+                    order.remove(t)
+                    self._drr_deficit.pop(t, None)
+                if not order:
+                    del self._drr_order[prio]
+                return req
+            if not order:
+                del self._drr_order[prio]
+        return None
+
+    def num_waiting_for(self, tenant: str) -> int:
+        """Waiting-queue depth for ONE tenant (per-tenant rate-limit
+        budgets); the global count with QoS off."""
+        if self.qos is None:
+            return self._waiting_count
+        with self._lock:
+            q = self._tenant_queues.get(tenant)
+            return len(q) if q else 0
 
     def _evict_slot(self, slot_idx: int, commit: bool = True):
         """Return a slot's pages to the pool and clear it.
@@ -1499,14 +1611,33 @@ class InferenceEngine:
         now = time.monotonic()
         did = False
         with self._lock:
-            expired = [r for r in self.waiting
-                       if r.deadline is not None and now > r.deadline]
-            if expired:
-                keep = collections.deque(
-                    r for r in self.waiting
-                    if not (r.deadline is not None and now > r.deadline))
-                self.waiting = keep
-                self._waiting_count = len(keep)
+            if self.qos is not None:
+                expired = []
+                for tenant in list(self._tenant_queues):
+                    q = self._tenant_queues[tenant]
+                    dead = [r for r in q
+                            if r.deadline is not None and now > r.deadline]
+                    if dead:
+                        keep = collections.deque(
+                            r for r in q
+                            if not (r.deadline is not None
+                                    and now > r.deadline))
+                        if keep:
+                            self._tenant_queues[tenant] = keep
+                        else:
+                            # the pop path lazily sweeps the DRR order
+                            del self._tenant_queues[tenant]
+                        self._waiting_count -= len(dead)
+                        expired.extend(dead)
+            else:
+                expired = [r for r in self.waiting
+                           if r.deadline is not None and now > r.deadline]
+                if expired:
+                    keep = collections.deque(
+                        r for r in self.waiting
+                        if not (r.deadline is not None and now > r.deadline))
+                    self.waiting = keep
+                    self._waiting_count = len(keep)
         for r in expired:
             self._expire_request(r)
             did = True
@@ -1695,6 +1826,18 @@ class InferenceEngine:
             free_slot = next((i for i, s in enumerate(self.slots)
                               if s.request is None), None)
             if free_slot is None:
+                # slot-level QoS preemption: a queued higher-priority
+                # request claims a slot from a strictly lower class
+                # instead of waiting out its whole decode — this is
+                # what holds the guaranteed tenant's TTFT under a
+                # best-effort flood (docs/qos.md degradation ladder)
+                if self.qos is not None:
+                    nxt = self._peek_waiting_priority()
+                    victim = (None if nxt is None
+                              else self._newest_slot(below_priority=nxt))
+                    if victim is not None:
+                        self._preempt_slot(victim)
+                        continue
                 return admitted
             req = self._pop_waiting()
             if req is None:
@@ -1737,11 +1880,18 @@ class InferenceEngine:
                      and self.host_kv.has(req.req_id))
         # leave one page of headroom per decoding slot so admissions
         # don't trigger immediate grow-preempt churn
-        headroom = sum(1 for i, s in enumerate(self.slots)
-                       if s.request is not None and self.active[i])
-        if self.allocator.available < -(-(n + 1) // self.cfg.page_size) + headroom:
-            self._requeue_front(req)
-            return False
+        while True:
+            headroom = sum(1 for i, s in enumerate(self.slots)
+                           if s.request is not None and self.active[i])
+            if self.allocator.available >= \
+                    -(-(n + 1) // self.cfg.page_size) + headroom:
+                break
+            # QoS: a higher-priority admission may evict lower-class
+            # sequences to make room (each eviction also shrinks the
+            # headroom term, so recompute)
+            if not self._preempt_one_lower(req):
+                self._requeue_front(req)
+                return False
         if self.prefix_cache is not None:
             # PD imports carry foreign KV bytes, spilled sequences
             # scatter host pages over their slots, and adapter requests
@@ -1754,6 +1904,8 @@ class InferenceEngine:
                                     or req.kv_device is not None
                                     or has_spill or req.adapter) else tokens
             res = self.prefix_cache.acquire(acquire_tokens, n + 1)
+            while res is None and self._preempt_one_lower(req):
+                res = self.prefix_cache.acquire(acquire_tokens, n + 1)
             if res is None:
                 self._requeue_front(req)
                 return False
@@ -1763,6 +1915,9 @@ class InferenceEngine:
             cached = min(cached, n - 1)
         else:
             pages_needed = -(-(n + 1) // self.cfg.page_size)
+            while pages_needed > self.allocator.available \
+                    and self._preempt_one_lower(req):
+                pass
             if pages_needed > self.allocator.available:
                 self._requeue_front(req)
                 return False
@@ -2320,12 +2475,47 @@ class InferenceEngine:
             self._scatter_scales_jit = fn
         return fn
 
-    def _newest_slot(self) -> Optional[int]:
+    def _newest_slot(self, below_priority: Optional[int] = None
+                     ) -> Optional[int]:
+        """Preemption victim.  Legacy (QoS off): the newest-admitted
+        sequence.  With QoS: the newest sequence of the LOWEST priority
+        class present — a guaranteed tenant only yields once every
+        lower class has.  ``below_priority`` restricts candidates to
+        strictly lower classes (admission-side preemption must never
+        evict a peer or better to make room)."""
         candidates = [i for i, s in enumerate(self.slots)
                       if s.request is not None]
+        if below_priority is not None:
+            candidates = [i for i in candidates
+                          if self.slots[i].request.priority < below_priority]
         if not candidates:
             return None
-        return max(candidates, key=lambda i: self.slots[i].seq)
+        if self.qos is None:
+            return max(candidates, key=lambda i: self.slots[i].seq)
+        return max(candidates,
+                   key=lambda i: (-self.slots[i].request.priority,
+                                  self.slots[i].seq))
+
+    def _peek_waiting_priority(self) -> Optional[int]:
+        """Highest priority class with a queued request (QoS only)."""
+        with self._lock:
+            for prio in sorted(self._drr_order, reverse=True):
+                if any(self._tenant_queues.get(t)
+                       for t in self._drr_order[prio]):
+                    return prio
+        return None
+
+    def _preempt_one_lower(self, req: Request) -> bool:
+        """Admission-side preemption (QoS only): evict one strictly
+        lower-priority sequence to make page room for ``req``.  Returns
+        False when nothing lower is running — the request waits."""
+        if self.qos is None:
+            return False
+        victim = self._newest_slot(below_priority=req.priority)
+        if victim is None:
+            return False
+        self._preempt_slot(victim)
+        return True
 
     def _ensure_decode_pages(self, lookahead: int = 1):
         """Reserve-on-demand: before a decode step, every active slot

@@ -261,9 +261,22 @@ class EngineMetrics:
     """The engine's metric family (names mirror vLLM's so the KEDA
     scaler/EPP configs translate 1:1)."""
 
-    def __init__(self, engine=None):
+    def __init__(self, engine=None, qos=None):
         self.registry = Registry()
         r = self.registry
+        # per-tenant slices exist ONLY with a QoS config: collect()
+        # emits HELP/TYPE lines even for an empty family, and the
+        # QoS-off exposition must stay byte-identical (docs/qos.md)
+        self.tenant_shed = None
+        self.tenant_served = None
+        if qos is not None:
+            self.tenant_shed = Counter(
+                "kaito:requests_shed_total",
+                "Requests shed by admission control, per tenant", r,
+                labels=("tenant",))
+            self.tenant_served = Counter(
+                "kaito:requests_served_total",
+                "Requests completed, per tenant", r, labels=("tenant",))
         self.prompt_tokens = Counter(
             "kaito:prompt_tokens_total", "Prefill tokens processed", r)
         self.generation_tokens = Counter(
@@ -424,5 +437,7 @@ class EngineMetrics:
                 self.tpot.observe(
                     (req.finish_time - req.first_token_time) / (n_out - 1))
             self.request_success.inc(finished_reason=req.finish_reason or "stop")
+            if self.tenant_served is not None and getattr(req, "tenant", ""):
+                self.tenant_served.inc(tenant=req.tenant)
         self.prompt_tokens.inc(len(req.prompt_tokens))
         self.generation_tokens.inc(len(req.output_tokens))

@@ -82,6 +82,8 @@ def _wire_request(req: Request) -> dict:
         "min_p": p.min_p,
         "adapter": req.adapter,
         "trace_id": req.trace_id,
+        "tenant": req.tenant,
+        "priority": req.priority,
     }
 
 
@@ -98,7 +100,9 @@ def _unwire_request(item: dict) -> Request:
         min_p=float(item.get("min_p", 0.0)))
     return Request(item["req_id"], list(item["tokens"]), params,
                    adapter=item.get("adapter", ""),
-                   trace_id=item.get("trace_id") or item["req_id"])
+                   trace_id=item.get("trace_id") or item["req_id"],
+                   tenant=item.get("tenant", ""),
+                   priority=int(item.get("priority", 0)))
 
 
 class MultiHostEngine(InferenceEngine):
@@ -122,7 +126,8 @@ class MultiHostEngine(InferenceEngine):
 
     def submit(self, prompt_tokens, params, req_id=None,
                export_kv=False, adapter: str = "",
-               timeout_s=None, trace_id=None) -> Request:
+               timeout_s=None, trace_id=None,
+               tenant: str = "", priority: str = "") -> Request:
         if not self.is_leader:
             raise RuntimeError("submit() is leader-only; workers receive "
                                "requests via the step broadcast")
@@ -142,10 +147,12 @@ class MultiHostEngine(InferenceEngine):
                 params = dataclasses.replace(
                     params, seed=self.counters["requests_total"])
             rid = req_id or f"req-{self.counters['requests_total']}"
+            t, prio = self._resolve_qos(tenant, priority)
             req = Request(rid,
                           list(prompt_tokens), params, adapter=adapter,
                           deadline=self._deadline_for(timeout_s),
-                          trace_id=trace_id or rid)
+                          trace_id=trace_id or rid,
+                          tenant=t, priority=prio)
             self._staged.append(req)
         self._wake.set()
         return req
@@ -230,7 +237,10 @@ class MultiHostEngine(InferenceEngine):
         with self._lock:
             for req in reqs:
                 self._waiting_count += 1
-                self.waiting.append(req)
+                if self.qos is None:
+                    self.waiting.append(req)
+                else:
+                    self._qos_push_locked(req)
                 self._live[req.req_id] = req
         for rid in payload["aborts"]:
             req = self._live.get(rid)

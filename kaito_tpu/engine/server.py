@@ -81,9 +81,17 @@ class ServerState:
     def __init__(self, engine: InferenceEngine, cfg: EngineConfig):
         self.engine = engine
         self.cfg = cfg
-        self.metrics = EngineMetrics(engine)
+        # multi-tenant QoS (docs/qos.md): the engine already parsed the
+        # config; the limiter, metrics and SLO watchdog share it so the
+        # whole degradation ladder attributes pressure per tenant
+        self.qos = getattr(engine, "qos", None)
+        self.metrics = EngineMetrics(engine, qos=self.qos)
         self.limiter = RateLimiter(cfg.max_queue_len, cfg.disable_rate_limit,
-                                   kv_shed_threshold=cfg.kv_shed_threshold)
+                                   kv_shed_threshold=cfg.kv_shed_threshold,
+                                   qos=self.qos)
+        # the probe-errors counter is limiter-owned; expose it through
+        # the shared registry (same adoption as the engine histograms)
+        self.metrics.registry.register(self.limiter.probe_errors)
         self.model_name = cfg.served_model_name or engine.md.name
         self.adapters = discover_adapters(cfg.adapters_dir)
         self.started = time.time()
@@ -95,7 +103,8 @@ class ServerState:
                 ttft_p99_s=cfg.slo_ttft_p99_ms / 1000.0,
                 tokens_per_sec_per_chip=cfg.slo_tokens_per_sec_per_chip,
                 availability=cfg.slo_availability)),
-            chips=engine_chip_count(engine))
+            chips=engine_chip_count(engine),
+            per_tenant=self.qos is not None)
         self.slo.register_metrics(self.metrics.registry)
         self._profile_timer: Optional[threading.Timer] = None
 
@@ -158,6 +167,28 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         except (ValueError, json.JSONDecodeError):
             self._error(400, "invalid JSON body")
             return None
+
+    def _intake_tenant(self, body: dict) -> Optional[tuple[str, str]]:
+        """Resolve this request's (tenant id, priority-class name) from
+        the ``X-Kaito-Tenant`` / ``X-Kaito-Priority`` headers (body
+        ``tenant`` / ``priority`` fields as fallback, docs/qos.md).
+        Sends a 400 and returns None on an invalid value.  With QoS
+        off, the tenant still rides along for tracing but nothing
+        downstream reads it."""
+        from kaito_tpu.engine.qos import valid_tenant
+
+        tenant = (self.headers.get("X-Kaito-Tenant")
+                  or body.get("tenant") or "").strip()
+        priority = (self.headers.get("X-Kaito-Priority")
+                    or body.get("priority") or "").strip()
+        if tenant and not valid_tenant(tenant):
+            self._error(400, "invalid tenant id (label-safe, max 64 chars)")
+            return None
+        qos = self.state.qos
+        if priority and qos is not None and priority not in qos.classes:
+            self._error(400, f"unknown priority class {priority!r}")
+            return None
+        return tenant, priority
 
     def _sse_start(self):
         self.send_response(200)
@@ -512,7 +543,8 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             self._rid = str(meta["trace_id"])
 
     def _submit_with_transfer(self, kv_src: dict, params,
-                              timeout_s: float = 0.0):
+                              timeout_s: float = 0.0,
+                              tenant: str = "", priority: str = ""):
         """Continue decoding from a remote prefill's KV.
 
         Chunked overlapped pull: a handshake fetches the chunk plan,
@@ -570,7 +602,8 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                                 params,
                                 req_id=f"cmpl-{uuid.uuid4().hex[:20]}",
                                 timeout_s=timeout_s,
-                                trace_id=self._rid)
+                                trace_id=self._rid, tenant=tenant,
+                                priority=priority)
                         except ValueError:
                             # a rejected submit must not destroy the
                             # prefill result: re-stage for retry/wire
@@ -633,7 +666,8 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             req = eng.submit_with_kv_chunked(
                 prompt_tokens, first, meta, plans, params,
                 req_id=f"cmpl-{uuid.uuid4().hex[:20]}",
-                timeout_s=timeout_s, trace_id=self._rid)
+                timeout_s=timeout_s, trace_id=self._rid,
+                tenant=tenant, priority=priority)
         except ValueError as e:
             self._error(400, str(e))
             return None
@@ -677,21 +711,33 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         body = self._read_body()
         if body is None:
             return
-        shed = st.limiter.shed_reason(st.engine)
+        qos_ids = self._intake_tenant(body)
+        if qos_ids is None:
+            return
+        tenant, priority = qos_ids
+        shed = st.limiter.shed_reason(st.engine, tenant=tenant)
         if shed is not None:
+            reason, shed_tenant = shed["reason"], shed["tenant"]
             st.metrics.requests_rejected.inc()
-            st.metrics.requests_shed.inc(reason=shed)
-            st.slo.note_shed()
+            st.metrics.requests_shed.inc(reason=reason)
+            if st.metrics.tenant_shed is not None:
+                st.metrics.tenant_shed.inc(tenant=shed_tenant or "default")
+            st.slo.note_shed(tenant=shed_tenant)
             try:
                 # best-effort: the flight recorder reports shed pressure
                 # per step (the DP facade's computed counters drop this)
                 st.engine.counters["requests_shed_total"] += 1
             except (KeyError, TypeError):
                 pass
-            retry_after = st.limiter.retry_after_s(st.engine)
-            self._error(429,
-                        "engine queue full, retry later" if shed == "queue_full"
-                        else "KV page pool saturated, retry later",
+            retry_after = st.limiter.retry_after_s(st.engine, key=self._rid)
+            messages = {
+                "queue_full": "engine queue full, retry later",
+                "tenant_queue_full": "tenant queue budget exhausted, "
+                                     "retry later",
+                "tenant_rate": "tenant token budget exhausted, retry later",
+                "kv_pressure": "KV page pool saturated, retry later",
+            }
+            self._error(429, messages.get(reason, "over capacity"),
                         "rate_limit_error",
                         headers={"Retry-After": retry_after})
             return
@@ -845,7 +891,9 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         try:
             if kv_src:
                 req = self._submit_with_transfer(kv_src, params,
-                                                 timeout_s=timeout_s)
+                                                 timeout_s=timeout_s,
+                                                 tenant=tenant,
+                                                 priority=priority)
                 if req is None:
                     return  # error already sent
                 tokens = req.prompt_tokens
@@ -853,7 +901,8 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                 req = st.engine.submit(tokens, params,
                                        req_id=f"cmpl-{uuid.uuid4().hex[:20]}",
                                        adapter=adapter, timeout_s=timeout_s,
-                                       trace_id=self._rid)
+                                       trace_id=self._rid, tenant=tenant,
+                                       priority=priority)
         except ValueError as e:
             return self._error(400, str(e))
 
@@ -869,7 +918,7 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                 extra_reqs.append(st.engine.submit(
                     tokens, p_i, req_id=f"{req.req_id}-{ci}",
                     adapter=adapter, timeout_s=timeout_s,
-                    trace_id=self._rid))
+                    trace_id=self._rid, tenant=tenant, priority=priority))
             except ValueError as e:
                 for r in [req] + extra_reqs:
                     st.engine.abort(r)
@@ -930,6 +979,8 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             self._sse_end()
             st.metrics.observe_request(req)
             st.slo.observe_request(req)
+            st.limiter.note_tokens(
+                req.tenant, len(req.prompt_tokens) + len(req.output_tokens))
             return
 
         choices = []
@@ -1015,6 +1066,10 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         resp.update({"choices": choices, "usage": usage})
         st.metrics.observe_request(req)
         st.slo.observe_request(req)
+        # post-paid token budgets: debit every choice's actual usage
+        for r in all_reqs:
+            st.limiter.note_tokens(
+                r.tenant, len(r.prompt_tokens) + len(r.output_tokens))
         self._json(200, resp)
 
 
@@ -1226,6 +1281,11 @@ def main(argv=None):
                          "(0 disables; reference contract "
                          "inference_api.py:503-556)")
     ap.add_argument("--max-queue-len", type=int, default=256)
+    ap.add_argument("--qos-config",
+                    default=os.environ.get("KAITO_QOS_CONFIG", ""),
+                    help="multi-tenant QoS classes as inline JSON or "
+                         "@path to a file (docs/qos.md); '' = off "
+                         "(single implicit tenant, legacy scheduling)")
     ap.add_argument("--max-pages", type=int, default=0,
                     help="KV page-pool size override (0 = size from "
                          "free HBM; vLLM num_gpu_blocks_override parity)")
@@ -1300,6 +1360,7 @@ def main(argv=None):
             args.kaito_kv_cache_cpu_memory_utilization
             * os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES")),
         max_queue_len=args.max_queue_len,
+        qos_config=args.qos_config,
         max_pages=args.max_pages,
         speculative_ngram=args.speculative_ngram,
         speculative_draft=args.speculative_draft,

@@ -68,6 +68,9 @@ def build_engine_command(
         "kaito-tpu.io/kv-cache-dtype", "")
     if kv_dtype:
         args += ["--kv-cache-dtype", kv_dtype]
+    qos = ws.metadata.annotations.get("kaito-tpu.io/qos", "")
+    if qos:
+        args += ["--qos-config", qos]
     spec_draft = ws.metadata.annotations.get(
         "kaito-tpu.io/speculative-draft", "")
     if spec_draft:

@@ -35,9 +35,10 @@ import threading
 from typing import Iterable, Optional
 
 from kaito_tpu.engine.metrics import Counter, Gauge, Registry
+from kaito_tpu.engine.qos import priority_rank
 from kaito_tpu.runtime.routing import (Backend, PrefixAffinityIndex,
-                                       RoutingCore, make_routing_server,
-                                       prefix_blocks)
+                                       RoutingCore, _MASK64, _fnv1a,
+                                       make_routing_server, prefix_blocks)
 
 logger = logging.getLogger(__name__)
 
@@ -63,6 +64,10 @@ def default_epp_plugins_config() -> dict:
             {"type": "kv-locality-scorer", "weight": 2},
             {"type": "queue-depth-scorer", "weight": 1},
             {"type": "kv-load-scorer", "weight": 1},
+            # QoS (docs/qos.md): both are inert (score 0) for requests
+            # without an X-Kaito-Tenant / X-Kaito-Priority header
+            {"type": "tenant-stickiness-scorer", "weight": 1},
+            {"type": "priority-scorer", "weight": 1},
         ],
     }
 
@@ -70,7 +75,8 @@ def default_epp_plugins_config() -> dict:
 class RequestCtx:
     """Everything scoring needs, parsed once per request."""
 
-    __slots__ = ("blocks", "matched", "kv_source", "want_role", "steered")
+    __slots__ = ("blocks", "matched", "kv_source", "want_role", "steered",
+                 "tenant", "priority")
 
     def __init__(self):
         self.blocks: list[int] = []            # prompt prefix block hashes
@@ -78,6 +84,8 @@ class RequestCtx:
         self.kv_source: str = ""               # kv_transfer.source_url
         self.want_role: str = ""               # "", "prefill", "decode"
         self.steered = False                   # PD locality won the pick
+        self.tenant: str = ""                  # X-Kaito-Tenant (QoS)
+        self.priority: str = ""                # X-Kaito-Priority class name
 
 
 def _extract_prompt(body: Optional[bytes]) -> str:
@@ -191,8 +199,14 @@ class EndpointPicker(RoutingCore):
 
     # -- scoring -----------------------------------------------------------
     def make_ctx(self, method: str, path: str,
-                 body: Optional[bytes]) -> RequestCtx:
+                 body: Optional[bytes], headers=None) -> RequestCtx:
         ctx = RequestCtx()
+        if headers is not None:
+            # the picker runs in its own pod with only the wire to go
+            # on: headers are the QoS intake (body fields as fallback,
+            # matching the engine server's contract)
+            ctx.tenant = (headers.get("X-Kaito-Tenant") or "").strip()
+            ctx.priority = (headers.get("X-Kaito-Priority") or "").strip()
         if method != "POST":
             return ctx
         if path.startswith("/pd/prefill"):
@@ -206,6 +220,14 @@ class EndpointPicker(RoutingCore):
             ctx.blocks = prefix_blocks(prompt, self.block_chars)
             if ctx.blocks:
                 ctx.matched = self.index.match(ctx.blocks)
+        if not ctx.tenant or not ctx.priority:
+            try:
+                obj = json.loads(body) if body else {}
+            except (ValueError, UnicodeDecodeError):
+                obj = {}
+            if isinstance(obj, dict):
+                ctx.tenant = ctx.tenant or str(obj.get("tenant") or "")
+                ctx.priority = ctx.priority or str(obj.get("priority") or "")
         return ctx
 
     def _filter_role(self, ctx: RequestCtx,
@@ -242,6 +264,25 @@ class EndpointPicker(RoutingCore):
             elif ptype == "kv-load-scorer":
                 total += weight * (1.0 - min(1.0, max(
                     b.load.kv_usage, b.load.occupancy)))
+            elif ptype == "tenant-stickiness-scorer":
+                # rendezvous hash of (tenant, backend): a tenant's
+                # traffic concentrates on one healthy replica so its
+                # prefix cache stays warm there — without a shared
+                # index, and stable as the pool changes.  Saturated
+                # replicas earn nothing (stickiness must not pile onto
+                # a full backend).
+                if ctx.tenant and not b.saturated and b.state == "closed":
+                    h = _fnv1a(f"{ctx.tenant}|{b.url}".encode(), 0)
+                    total += weight * (h / float(_MASK64))
+            elif ptype == "priority-scorer":
+                # high-priority traffic avoids loaded backends harder:
+                # the rank scales the headroom term, so best-effort
+                # ("" / rank 0) is indifferent while guaranteed traffic
+                # strongly prefers the emptiest replica
+                rank = priority_rank(ctx.priority)
+                if rank > 0:
+                    total += weight * rank * (1.0 - min(1.0, max(
+                        b.load.occupancy, b.load.kv_usage)))
             # pd-filter participates as a filter, not a scorer;
             # unknown plugin types are ignored (forward compat)
         return total
@@ -264,8 +305,11 @@ class EndpointPicker(RoutingCore):
         alive = [b for b in pool if b.alive and not b.draining]
         draining = [b for b in pool if b.alive and b.draining]
         dead = [b for b in pool if not b.alive]
-        # stable sort: score ties fall back to least-loaded-first order
-        alive.sort(key=lambda b: (-self._score(b, ctx), b.load.waiting))
+        # stable sort: score ties fall back to least-loaded-first order;
+        # replicas inside a 429 Retry-After window sort after every
+        # non-demoted peer regardless of score (healthy but shedding)
+        alive.sort(key=lambda b: (b.demoted, -self._score(b, ctx),
+                                  b.load.waiting))
         for b in alive + draining + dead:
             with self._lock:
                 b.served += 1

@@ -54,7 +54,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Iterable, Optional
 
 from kaito_tpu.runtime.slo import WindowSeries
-from kaito_tpu.utils.promtext import parse_exposition
+from kaito_tpu.utils.promtext import parse_exposition, parse_labels
 
 logger = logging.getLogger(__name__)
 
@@ -99,6 +99,12 @@ _EPP_COUNTERS = {
     "kaito:router_requests_forwarded_total": "forwarded_total",
     "kaito:epp_requests_forwarded_total": "forwarded_total",
     "kaito:router_requests_received_total": "received_total",
+}
+# tenant-labelled counters (present only when the engine runs with a
+# QoS config) -> dynamic per-tenant keys "tenant_<what>_total:<tenant>"
+_TENANT_COUNTERS = {
+    "kaito:requests_shed_total": "tenant_shed_total",
+    "kaito:requests_served_total": "tenant_served_total",
 }
 
 
@@ -387,7 +393,7 @@ def parse_replica_metrics(text: str) -> dict[str, float]:
     ``routing.parse_load_metrics`` — robust to DP-grouped engines."""
     sums: dict[str, list[float]] = {}
     means: dict[str, list[float]] = {}
-    for name, _labels, value in parse_exposition(text):
+    for name, labels, value in parse_exposition(text):
         gauge = _ENGINE_GAUGES.get(name)
         if gauge is not None:
             key, fold = gauge
@@ -397,6 +403,12 @@ def parse_replica_metrics(text: str) -> dict[str, float]:
         ctr = _ENGINE_COUNTERS.get(name) or _EPP_COUNTERS.get(name)
         if ctr is not None:
             sums.setdefault(ctr, []).append(value)
+            continue
+        ten = _TENANT_COUNTERS.get(name)
+        if ten is not None:
+            tenant = parse_labels(labels).get("tenant", "")
+            if tenant:
+                sums.setdefault(f"{ten}:{tenant}", []).append(value)
     out = {k: sum(v) for k, v in sums.items()}
     out.update({k: sum(v) / len(v) for k, v in means.items()})
     return out
@@ -603,16 +615,23 @@ class FleetTelemetry:
         dt = now - prev.ts
         restarted = values.get("uptime_s", float("inf")) < dt
         out = {}
-        for key in ("requests_total", "shed_total", "gen_tokens_total",
-                    "prefix_hits_total", "prefix_misses_total",
-                    "spec_proposed_total", "spec_accepted_total",
-                    "forwarded_total", "received_total"):
+        keys = ["requests_total", "shed_total", "gen_tokens_total",
+                "prefix_hits_total", "prefix_misses_total",
+                "spec_proposed_total", "spec_accepted_total",
+                "forwarded_total", "received_total"]
+        # per-tenant counters carry the tenant in the key itself
+        # ("tenant_shed_total:acme"), so rate whatever both samples have
+        keys += [k for k in values if k.startswith("tenant_")
+                 and "_total:" in k]
+        for key in keys:
             if key not in values or key not in prev.values:
                 continue
             delta = values[key] - prev.values[key]
             if delta < 0 or restarted:
                 delta = 0.0
-            out[key[:-len("_total")] + "_rate"] = delta / dt
+            stem, _, tenant = key.partition(":")
+            rkey = stem[:-len("_total")] + "_rate"
+            out[f"{rkey}:{tenant}" if tenant else rkey] = delta / dt
         return out
 
     def scrape_once(self, force: bool = False, wait: bool = True) -> int:
@@ -753,6 +772,12 @@ class FleetTelemetry:
             agg["received_rate"] = sum(
                 s.rates.get("received_rate", 0.0) for s in epps)
             agg["epp_reporting"] = float(len(epps))
+        # per-tenant slices (QoS engines only): sum each tenant's
+        # shed/served rate across replicas, keyed "tenant_shed_rate:<t>"
+        for s in replicas:
+            for rk, rv in s.rates.items():
+                if rk.startswith("tenant_") and ":" in rk:
+                    agg[rk] = agg.get(rk, 0.0) + rv
         return agg
 
     # -- evaluation + condition/event surfacing ------------------------
@@ -938,6 +963,27 @@ class FleetTelemetry:
         Gauge("kaito:fleet_slo_burn_max",
               "Worst replica fast-window SLO burn per CR", r,
               labels=("kind", "name"), fn=family("burn_max"))
+
+        def tenant_family(prefix):
+            def _fn():
+                out = {}
+                with self._lock:
+                    for k, agg in self._last_agg.items():
+                        for ak, v in agg.items():
+                            if ak.startswith(prefix):
+                                tenant = ak[len(prefix):]
+                                out[(k[0], k[2], tenant)] = v
+                return out
+            return _fn
+
+        Gauge("kaito:fleet_tenant_served_per_s",
+              "Fleet per-tenant completion rate (QoS engines only)", r,
+              labels=("kind", "name", "tenant"),
+              fn=tenant_family("tenant_served_rate:"))
+        Gauge("kaito:fleet_tenant_shed_per_s",
+              "Fleet per-tenant admission-shed rate (QoS engines only)",
+              r, labels=("kind", "name", "tenant"),
+              fn=tenant_family("tenant_shed_rate:"))
 
         def _states():
             with self._lock:

@@ -115,6 +115,9 @@ class Backend:
         self.role = role           # "" | "prefill" | "decode" | "both"
         self.group = group         # replica group for PD KV locality
         self.down_until = 0.0
+        # 429 advisory window (Retry-After): the replica is healthy but
+        # FULL — no breaker trip, just deprioritized for new picks
+        self.avoid_until = 0.0
         self.served = 0
         self.failures = 0
         self.load = BackendLoad()
@@ -127,6 +130,17 @@ class Backend:
     @property
     def alive(self) -> bool:
         return time.monotonic() >= self.down_until
+
+    @property
+    def demoted(self) -> bool:
+        """Inside a 429 Retry-After advisory window: last-resort only."""
+        return time.monotonic() < self.avoid_until
+
+    def demote(self, seconds: float) -> None:
+        """A 429 with Retry-After: honor the advisory window without
+        touching the breaker (the replica is alive, just shedding)."""
+        self.avoid_until = max(self.avoid_until,
+                               time.monotonic() + max(0.0, seconds))
 
     @property
     def state(self) -> str:
@@ -511,6 +525,11 @@ class RoutingCore:
             "kaito:router_backend_failures_total",
             "Connect/forward failures that skipped a backend", r,
             labels=("backend",))
+        self.m_rate_limited = Counter(
+            "kaito:router_backend_rate_limited_total",
+            "429 responses that demoted a backend for its Retry-After "
+            "window (request failed over, breaker untouched)", r,
+            labels=("backend",))
         self.upstream_latency = Histogram(
             "kaito:router_upstream_latency_seconds",
             "Forward-to-response-head latency per backend", r,
@@ -532,16 +551,23 @@ class RoutingCore:
 
     # -- selection policy --------------------------------------------------
     def next_backend(self) -> Optional[Backend]:
-        """Next live non-draining backend (round robin); draining
-        backends are last-resort only (they still serve correctly —
-        better that than a 503 — but new work prefers survivors), and
-        if every backend is cooling down, the next one regardless
-        (better a refused retry than a guaranteed 503 when all marks
-        are stale)."""
+        """Next live non-draining non-demoted backend (round robin);
+        replicas inside a 429 Retry-After window come next (they are
+        healthy, just shedding), draining backends are last-resort only
+        (they still serve correctly — better that than a 503 — but new
+        work prefers survivors), and if every backend is cooling down,
+        the next one regardless (better a refused retry than a
+        guaranteed 503 when all marks are stale)."""
         with self._lock:
             n = len(self.backends)
             if n == 0:
                 return None
+            for offset in range(n):
+                b = self.backends[(self._rr + offset) % n]
+                if b.alive and not b.draining and not b.demoted:
+                    self._rr = (self._rr + offset + 1) % n
+                    b.served += 1
+                    return b
             for offset in range(n):
                 b = self.backends[(self._rr + offset) % n]
                 if b.alive and not b.draining:
@@ -572,9 +598,10 @@ class RoutingCore:
         return found
 
     def make_ctx(self, method: str, path: str,
-                 body: Optional[bytes]):
-        """Parse whatever the front's scoring needs out of the request.
-        The base (round-robin) front needs nothing."""
+                 body: Optional[bytes], headers=None):
+        """Parse whatever the front's scoring needs out of the request
+        (``headers`` carries the QoS tenant/priority intake).  The base
+        (round-robin) front needs nothing."""
         return None
 
     def candidates(self, method: str, path: str, ctx) -> Iterable[Backend]:
@@ -728,7 +755,8 @@ def make_routing_server(core: RoutingCore, host: str = "0.0.0.0",
             # Retryable requests get RETRY_CYCLES full passes over the
             # candidate order with a jittered backoff between passes;
             # one-shot (non-idempotent) requests get a single pass.
-            ctx = core.make_ctx(method, self.path, body)
+            ctx = core.make_ctx(method, self.path, body,
+                                headers=self.headers)
             retryable = _retryable(method, self.path)
             cycles = RETRY_CYCLES if retryable else 1
             last_status: Optional[int] = None
@@ -758,6 +786,23 @@ def make_routing_server(core: RoutingCore, host: str = "0.0.0.0",
                         # the replica answered but cannot serve (loading
                         # stub, drain, overload): try elsewhere.  The
                         # breaker does NOT trip — the process is alive.
+                        last_status = resp.status
+                        conn.close()
+                        continue
+                    if retryable and resp.status == 429 \
+                            and (cycle + 1 < cycles or remaining > 0):
+                        # shedding replica: honor its Retry-After as a
+                        # demotion window (healthy-but-full, no breaker
+                        # trip) and fail over to the next candidate NOW
+                        # — a shed request should move, not die
+                        try:
+                            ra = min(60.0, max(
+                                1.0, float(resp.getheader("Retry-After")
+                                           or 1)))
+                        except (TypeError, ValueError):
+                            ra = 1.0
+                        b.demote(ra)
+                        core.m_rate_limited.inc(backend=b.url)
                         last_status = resp.status
                         conn.close()
                         continue

@@ -162,7 +162,8 @@ class SLOWatchdog:
     def __init__(self, targets: Optional[SLOTargets] = None, chips: int = 1,
                  windows: tuple[float, float] = (WINDOW_FAST_S,
                                                 WINDOW_SLOW_S),
-                 time_fn: Callable[[], float] = time.monotonic):
+                 time_fn: Callable[[], float] = time.monotonic,
+                 per_tenant: bool = False):
         self.targets = targets or SLOTargets()
         self.chips = max(1, int(chips))
         self.window_fast_s, self.window_slow_s = windows
@@ -174,30 +175,64 @@ class SLOWatchdog:
         self.success = WindowSeries(slow, time_fn)
         self.failure = WindowSeries(slow, time_fn)
         self.shed = WindowSeries(slow, time_fn)
+        # per-tenant QoS slices (docs/qos.md): only with a QoS config —
+        # the gauges they feed must not exist in the QoS-off exposition
+        self.per_tenant = per_tenant
+        self._tenant_ttft: dict[str, WindowSeries] = {}
+        self._tenant_shed: dict[str, WindowSeries] = {}
 
     # -- feeds ---------------------------------------------------------
 
-    def observe_ttft(self, seconds: float) -> None:
+    def _tenant_series(self, store: dict, tenant: str) -> WindowSeries:
+        s = store.get(tenant)
+        if s is None:
+            s = store[tenant] = WindowSeries(self.window_slow_s,
+                                             self.time_fn)
+        return s
+
+    def observe_ttft(self, seconds: float, tenant: str = "") -> None:
         self.ttft.add(seconds)
+        if self.per_tenant and tenant:
+            self._tenant_series(self._tenant_ttft, tenant).add(seconds)
 
     def note_tokens(self, n: int) -> None:
         if n > 0:
             self.tokens.add(n)
 
-    def note_shed(self, n: int = 1) -> None:
+    def note_shed(self, n: int = 1, tenant: str = "") -> None:
         self.shed.add(n)
+        if self.per_tenant and tenant:
+            self._tenant_series(self._tenant_shed, tenant).add(n)
 
     def observe_request(self, req) -> None:
         """Feed one finished engine Request (the server calls this next
         to EngineMetrics.observe_request)."""
         if getattr(req, "first_token_time", None):
-            self.observe_ttft(req.first_token_time - req.submit_time)
+            self.observe_ttft(req.first_token_time - req.submit_time,
+                              tenant=getattr(req, "tenant", ""))
         self.note_tokens(len(getattr(req, "output_tokens", ()) or ()))
         if getattr(req, "finish_time", None) or \
                 getattr(req, "finish_reason", None):
             ok = getattr(req, "finish_reason", None) not in \
                 ("error", "deadline")
             (self.success if ok else self.failure).add(1)
+
+    # -- per-tenant view (docs/qos.md) ---------------------------------
+
+    def tenant_snapshot(self) -> dict:
+        """Fast-window TTFT p50 and shed count per tenant — the
+        degradation ladder's observable: a guaranteed tenant's p50
+        holds while best-effort sheds climb."""
+        out: dict = {}
+        for t in sorted(set(self._tenant_ttft) | set(self._tenant_shed)):
+            ttfts = (self._tenant_ttft[t].values(self.window_fast_s)
+                     if t in self._tenant_ttft else [])
+            shed = (self._tenant_shed[t].total(self.window_fast_s)
+                    if t in self._tenant_shed else 0.0)
+            out[t] = {"ttft_p50_s": round(_percentile(ttfts, 0.50), 6),
+                      "ttft_samples": len(ttfts),
+                      "shed": int(shed)}
+        return out
 
     # -- evaluation ----------------------------------------------------
 
@@ -261,7 +296,7 @@ class SLOWatchdog:
                        + [1.5 if fast["throughput_burning"] else 0.0])
         fast.pop("burn"), slow.pop("burn")
         fast.pop("throughput_burning"), slow.pop("throughput_burning")
-        return {
+        out = {
             "burn_max": round(burn_max, 4),
             "targets": self.targets.to_dict(),
             "windows": {"fast_s": self.window_fast_s,
@@ -272,6 +307,9 @@ class SLOWatchdog:
             "alerts": alerts,
             "healthy": all(a != STATE_PAGE for a in alerts.values()),
         }
+        if self.per_tenant:
+            out["tenants"] = self.tenant_snapshot()
+        return out
 
     # -- exposition ----------------------------------------------------
 
@@ -313,6 +351,23 @@ class SLOWatchdog:
         Gauge("kaito:slo_healthy",
               "1 while no SLI is in the page state", registry,
               fn=lambda: 1.0 if self.snapshot()["healthy"] else 0.0)
+        if self.per_tenant:
+            # QoS-only families — registering them unconditionally
+            # would add HELP/TYPE lines to the QoS-off exposition
+            def _tenant_ttfts() -> dict:
+                return {(t,): s["ttft_p50_s"]
+                        for t, s in self.tenant_snapshot().items()}
+
+            def _tenant_sheds() -> dict:
+                return {(t,): float(s["shed"])
+                        for t, s in self.tenant_snapshot().items()}
+
+            Gauge("kaito:slo_tenant_ttft_p50_seconds",
+                  "Rolling fast-window TTFT p50 per tenant", registry,
+                  labels=("tenant",), fn=_tenant_ttfts)
+            Gauge("kaito:slo_tenant_shed",
+                  "Fast-window requests shed per tenant", registry,
+                  labels=("tenant",), fn=_tenant_sheds)
 
 
 def condition_from_verdict(verdict: dict) -> tuple[str, str, str]:

@@ -119,6 +119,61 @@ def test_counter_deltas_become_rates_with_restart_detection():
     assert ft._rates(ReplicaSample(), {"requests_total": 4.0}, clock()) == {}
 
 
+TENANT_PAYLOAD = ENGINE_PAYLOAD + """\
+# TYPE kaito:requests_shed_total counter
+kaito:requests_shed_total{tenant="free"} 8
+kaito:requests_shed_total{tenant="acme"} 0
+# TYPE kaito:requests_served_total counter
+kaito:requests_served_total{tenant="acme"} 12
+"""
+
+
+def test_per_tenant_counters_parse_rate_and_aggregate():
+    vals = parse_replica_metrics(TENANT_PAYLOAD)
+    assert vals["tenant_shed_total:free"] == 8.0
+    assert vals["tenant_shed_total:acme"] == 0.0
+    assert vals["tenant_served_total:acme"] == 12.0
+    # a payload without the QoS families produces no tenant keys
+    assert not any(k.startswith("tenant_")
+                   for k in parse_replica_metrics(ENGINE_PAYLOAD))
+
+    clock = Clock()
+    ft = FleetTelemetry(Store(), time_fn=clock)
+    prev = ReplicaSample(ts=clock() - 10.0,
+                         values={"tenant_shed_total:free": 3.0,
+                                 "tenant_served_total:acme": 2.0,
+                                 "uptime_s": 50.0})
+    rates = ft._rates(prev, {"tenant_shed_total:free": 8.0,
+                             "tenant_served_total:acme": 12.0,
+                             "uptime_s": 60.0}, clock())
+    assert rates["tenant_shed_rate:free"] == pytest.approx(0.5)
+    assert rates["tenant_served_rate:acme"] == pytest.approx(1.0)
+
+    key = ("InferenceSet", "default", "qos")
+    ft.ingest(key, "http://r0:5000", {"waiting": 0.0},
+              rates={"tenant_shed_rate:free": 0.5,
+                     "tenant_served_rate:acme": 1.0}, replica="r0")
+    ft.ingest(key, "http://r1:5000", {"waiting": 0.0},
+              rates={"tenant_shed_rate:free": 1.5}, replica="r1")
+    ft.fold()
+    agg = ft._last_agg[key]
+    assert agg["tenant_shed_rate:free"] == pytest.approx(2.0)
+    assert agg["tenant_served_rate:acme"] == pytest.approx(1.0)
+
+    registry = Registry()
+    ft.register_metrics(registry)
+    by = {}
+    for name, labels, value in parse_exposition(registry.expose()):
+        by[(name, tuple(sorted(parse_labels(labels).items())))] = value
+    base = (("kind", "InferenceSet"), ("name", "qos"))
+    assert by[("kaito:fleet_tenant_shed_per_s",
+               tuple(sorted(base + (("tenant", "free"),))))] \
+        == pytest.approx(2.0)
+    assert by[("kaito:fleet_tenant_served_per_s",
+               tuple(sorted(base + (("tenant", "acme"),))))] \
+        == pytest.approx(1.0)
+
+
 # ---------------------------------------------------------------------------
 # pure evaluator: hysteresis + sustain
 # ---------------------------------------------------------------------------
