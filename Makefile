@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: all native unit-test unit-test-fast unit-test-slow engine-test rag-test chaos kvq obs slo fleet autoscale spec qos bench serve manager epp clean
+.PHONY: all native unit-test unit-test-fast unit-test-slow engine-test rag-test chaos kvq kvpool obs slo fleet autoscale spec qos bench serve manager epp clean
 
 all: native
 
@@ -40,6 +40,13 @@ chaos:
 kvq:
 	$(PYTHON) -m pytest tests/test_kv_quant.py -q
 	$(PYTHON) -m pytest tests/test_real_checkpoint.py -q -k "kv_int8"
+
+# cluster KV pool suite (docs/kv-pool.md): hash parity, store LRU +
+# export TTL GC, EPP index/scoring/headers, publish→fetch→import
+# greedy parity, gating invisibility — fast tier; the warm-TTFT-
+# survives-scale-out e2e is the slow leg
+kvpool:
+	$(PYTHON) -m pytest tests/test_kv_pool.py -q -m "not slow"
 
 # observability suite (docs/observability.md): tracing, flight
 # recorder, router metrics, exposition-format invariants, control-plane

@@ -386,6 +386,15 @@ def orchestrate(args):
             merged.setdefault("errors", []).append(res["error"])
         save_partial()
 
+    # --- phase: cluster KV pool cross-replica fetch (docs/kv-pool.md) ---
+    if not args.skip_pd_bench and remaining() > 90:
+        res = run_phase("kvpool", passthru, min(remaining(), 300.0))
+        if "error" not in res:
+            merged.update(res)
+        else:
+            merged.setdefault("errors", []).append(res["error"])
+        save_partial()
+
     # --- phase: context-parallel prefill scaling (virtual 8-dev mesh) ---
     if not args.skip_cp_bench and remaining() > 120:
         res = run_phase("cp", ["--cp-tokens", str(args.cp_tokens)],
@@ -1203,11 +1212,110 @@ def phase_pd(args):
     print(json.dumps(res), flush=True)
 
 
+def phase_kvpool(args):
+    """Cluster KV pool (docs/kv-pool.md): time an ACTUAL chunked prefix
+    transfer between two live engine servers — A serves a prompt and
+    publishes its prefix pages, B is handed the EPP-style fetch headers
+    and pulls them over the wire instead of recomputing.  Reports the
+    measured transfer alongside the static transfer-cost prior as
+    ``transfer_cost_model_error``: that prior is what every
+    route-vs-fetch decision eats before a replica has EWMA samples, so
+    its error IS the quality of cold-start fetch decisions."""
+    jax = _init_jax(force_cpu=args.force_cpu)
+    import urllib.request
+
+    from kaito_tpu.engine.config import EngineConfig
+    from kaito_tpu.engine.engine import InferenceEngine
+    from kaito_tpu.engine.pd import transfer_cost
+    from kaito_tpu.engine.server import make_server
+
+    on_tpu = jax.devices()[0].platform not in ("cpu",)
+    model_name = args.model or "tiny-llama-test"
+    cfg = EngineConfig(
+        model=model_name, max_model_len=512, page_size=16, max_num_seqs=2,
+        dtype="bfloat16" if on_tpu else "float32",
+        kv_dtype=args.kv_dtype or ("bfloat16" if on_tpu else "float32"),
+        prefill_buckets=(128, 256), seed=0, kv_pool_enabled=True)
+
+    def boot():
+        eng = InferenceEngine(cfg)
+        eng.start()
+        srv = make_server(eng, cfg, host="127.0.0.1", port=0)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return eng, srv, f"http://127.0.0.1:{srv.server_address[1]}"
+
+    def post(url, body, headers=None):
+        req = urllib.request.Request(
+            url + "/v1/completions", data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json", **(headers or {})})
+        return json.loads(urllib.request.urlopen(req, timeout=120).read())
+
+    a_eng, a_srv, a_url = boot()
+    b_eng, b_srv, b_url = boot()
+    out: dict = {"kvpool_model": model_name}
+    try:
+        # warm A: the finished request publishes its prefix pages
+        prompt = "cluster kv pool transfer bench " * 12
+        post(a_url, {"prompt": prompt, "max_tokens": 4,
+                     "temperature": 0.0})
+        with urllib.request.urlopen(a_url + "/debug/kv_pool",
+                                    timeout=10) as r:
+            advert = json.loads(r.read())
+        if not advert.get("entries"):
+            out["error"] = "kvpool: replica A published no prefix entry"
+            print(json.dumps(out), flush=True)
+            return
+        key = advert["entries"][0]["key"]
+        # B fetches: same prompt + the headers the EPP would inject
+        t0 = time.monotonic()
+        post(b_url, {"prompt": prompt, "max_tokens": 4,
+                     "temperature": 0.0},
+             headers={"X-Kaito-KV-Fetch": a_url,
+                      "X-Kaito-KV-Fetch-Key": key})
+        warm_e2e_s = time.monotonic() - t0
+        fetches = b_eng.counters["kv_pool_fetches_total"]
+        n_tokens = b_eng.counters["kv_pool_fetched_tokens_total"]
+        snap = b_eng.pd_costs.snapshot()
+        if fetches < 1 or not snap.get("net_bytes_s"):
+            out["error"] = "kvpool: no cross-replica fetch happened"
+            print(json.dumps(out), flush=True)
+            return
+        kv_itemsize = b_eng.cache.k.dtype.itemsize
+        scale_bpt = 0.0
+        if getattr(b_eng.cache, "k_scale", None) is not None:
+            arch = b_eng.md.arch
+            scale_bpt = (8.0 * arch.num_layers * arch.num_kv_heads
+                         / max(1, cfg.page_size))
+        modeled = transfer_cost(n_tokens, b_eng.md.arch, kv_itemsize,
+                                scale_bytes_per_token=scale_bpt)
+        # one transfer sample -> the EWMA is exactly bytes/seconds of
+        # the pull we just timed; scoring the prior against the same
+        # byte volume isolates BANDWIDTH error from byte-count error
+        measured_s = modeled["kv_bytes"] / snap["net_bytes_s"]
+        out.update({
+            "kvpool_fetch_tokens": int(n_tokens),
+            "kvpool_kv_bytes": int(modeled["kv_bytes"]),
+            "kvpool_measured_transfer_s": measured_s,
+            "kvpool_modeled_transfer_s": modeled["transfer_s"],
+            "kvpool_measured_net_bytes_s": snap["net_bytes_s"],
+            "kvpool_warm_e2e_s": warm_e2e_s,
+            "transfer_cost_model_error":
+                abs(modeled["transfer_s"] - measured_s)
+                / max(measured_s, 1e-9),
+        })
+        print(json.dumps(out), flush=True)
+    finally:
+        for s in (a_srv, b_srv):
+            s.shutdown()
+        a_eng.stop()
+        b_eng.stop()
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--phase", default="",
                     choices=["", "watch", "probe", "raw", "serve",
-                             "int8_8b", "pd", "cp", "prefix"])
+                             "int8_8b", "pd", "cp", "prefix", "kvpool"])
     ap.add_argument("--cp-tokens", type=int, default=8192)
     ap.add_argument("--cp-attn-only", action="store_true",
                     help="cp phase: measure only the per-chip shard-"
@@ -1260,6 +1368,8 @@ def main():
         phase_int8_8b(args)
     elif args.phase == "pd":
         phase_pd(args)
+    elif args.phase == "kvpool":
+        phase_kvpool(args)
     elif args.phase == "cp":
         phase_cp(args)
     else:

@@ -237,9 +237,19 @@ class InferenceSetReconciler(Reconciler):
         draining = sorted(f"http://{c.metadata.name}:{EPP_PORT}"
                           for c in children
                           if c.metadata.annotations.get(ANNOTATION_DRAINING))
+        # the same kaito-tpu.io/kv-pool annotation the workspace
+        # template renders into --kv-pool on the engines also arms the
+        # picker's advert scraper + fetch hints, so the two sides of
+        # the cluster KV pool can never be enabled apart (the template
+        # is what child workspaces inherit; the CR metadata is the
+        # manual escape hatch)
+        kv_pool = str(
+            iset.spec.template.annotations.get("kaito-tpu.io/kv-pool")
+            or iset.metadata.annotations.get("kaito-tpu.io/kv-pool")
+            or "").lower() in ("true", "1", "on", "enabled")
         objs = generate_epp_workload(
             f"{iset.metadata.name}-epp", ns, backends=backends,
-            draining=draining,
+            draining=draining, kv_pool=kv_pool,
             owner={"kind": "InferenceSet", "name": iset.metadata.name})
         for obj in objs:
             existing = self.store.try_get(obj.kind, ns, obj.metadata.name)

@@ -92,6 +92,16 @@ class EngineConfig:
     pd_enabled: bool = False             # P/D side-channel routes (MRI roles)
     pd_source_allowlist: str = ""        # comma URL prefixes for KV pulls
     max_queue_len: int = 256
+    # cluster-wide KV pool (docs/kv-pool.md): replicas publish whole-page
+    # prompt-prefix KV into a per-replica store served over the chunked
+    # PD wire; the EPP aggregates adverts into a prefix->holder index
+    # and either routes to the holder or tells the picked replica to
+    # fetch.  Default OFF: with the pool disabled, scheduling behavior
+    # and the /metrics exposition are byte-identical to before.
+    kv_pool_enabled: bool = False
+    kv_pool_bytes: int = 1 << 30         # host bytes for the prefix store
+    kv_pool_min_tokens: int = 0          # min prefix tokens to publish
+    # (0 = one KV page, i.e. page_size tokens)
     # multi-tenant QoS (docs/qos.md): JSON tenant-class document
     # (inline, or @path to a file) parsed by engine.qos.  "" = off —
     # one implicit tenant, legacy FIFO admission and

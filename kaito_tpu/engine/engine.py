@@ -135,6 +135,15 @@ class Request:
     # the scheduler's legacy single-FIFO behavior is untouched.
     tenant: str = ""
     priority: int = 0
+    # cluster-wide KV pool (docs/kv-pool.md): the request's chained
+    # prefix block hashes (one per whole KV page of prompt), computed
+    # at intake from the same bytes the EPP hashes; the finished
+    # prefill publishes its prefix pages under these.  kv_prefix_tokens
+    # marks an in-flight POOL fetch: kv_chunked holds only the first
+    # kv_prefix_tokens of prompt KV and prefill finishes the rest —
+    # any fetch failure silently falls back to a full local prefill.
+    pool_blocks: list = field(default_factory=list)
+    kv_prefix_tokens: int = 0
 
     @property
     def expired(self) -> bool:
@@ -414,6 +423,17 @@ class InferenceEngine:
             self.host_kv = HostKVPool(cfg.host_kv_offload_bytes)
             logger.info("host KV offload tier: %.2f GiB",
                         cfg.host_kv_offload_bytes / 2**30)
+        # cluster-wide KV pool (docs/kv-pool.md): replica-local store of
+        # published prompt prefixes, served over the chunked PD wire.
+        # None when the feature is off — every pool code path gates on
+        # it, keeping scheduling and /metrics byte-identical to before.
+        self.kv_pool = None
+        if getattr(cfg, "kv_pool_enabled", False):
+            from kaito_tpu.engine.kv_pool import PrefixPageStore
+
+            self.kv_pool = PrefixPageStore(cfg.kv_pool_bytes)
+            logger.info("cluster KV pool store: %.2f GiB",
+                        cfg.kv_pool_bytes / 2**30)
         S = cfg.max_num_seqs
         self.slots = [_Slot() for _ in range(S)]
         self.page_tables = np.zeros((S, self.pages_per_seq), np.int32)
@@ -488,6 +508,12 @@ class InferenceEngine:
             # observability (docs/observability.md)
             "prefill_tokens_total": 0,        # prefill tokens dispatched
             "requests_shed_total": 0,         # 429s (bumped by the server)
+            # cluster-wide KV pool (docs/kv-pool.md) — exposed on
+            # /metrics only when the pool is enabled
+            "kv_pool_fetches_total": 0,        # cross-replica prefix imports
+            "kv_pool_fetched_tokens_total": 0,  # prompt tokens skipped
+            "kv_pool_fetch_failures_total": 0,  # fell back to recompute
+            "kv_pool_published_total": 0,       # prefixes published locally
         }
         self._last_deadline_sweep = 0.0
         self._last_export_tick = 0.0
@@ -1241,7 +1267,8 @@ class InferenceEngine:
                export_kv: bool = False, adapter: str = "",
                timeout_s: Optional[float] = None,
                trace_id: Optional[str] = None,
-               tenant: str = "", priority: str = "") -> Request:
+               tenant: str = "", priority: str = "",
+               pool_blocks: Optional[list] = None) -> Request:
         self._validate_submit(prompt_tokens, params)
         if adapter and adapter not in self.adapter_index:
             raise ValueError(f"unknown adapter {adapter!r}")
@@ -1252,7 +1279,8 @@ class InferenceEngine:
                       adapter=adapter,
                       deadline=self._deadline_for(timeout_s),
                       trace_id=trace_id or rid,
-                      tenant=t, priority=prio)
+                      tenant=t, priority=prio,
+                      pool_blocks=list(pool_blocks or []))
         self._enqueue(req)
         return req
 
@@ -1338,6 +1366,59 @@ class InferenceEngine:
                       kv_retries=max(0, self.cfg.kv_import_retries),
                       trace_id=trace_id or meta.get("trace_id") or rid,
                       tenant=t, priority=prio)
+        self._enqueue(req)
+        return req
+
+    def submit_with_kv_prefix(self, prompt_tokens: list[int], meta: dict,
+                              plans, n_prefix_tokens: int,
+                              params: SamplingParams,
+                              req_id: Optional[str] = None,
+                              deadline_s: float = 30.0,
+                              timeout_s: Optional[float] = None,
+                              trace_id: Optional[str] = None,
+                              tenant: str = "", priority: str = "",
+                              adapter: str = "",
+                              pool_blocks: Optional[list] = None):
+        """Cluster-KV-pool entry (docs/kv-pool.md): a PARTIAL prefix of
+        the prompt's KV is being fetched from a holder replica over the
+        chunked wire; the local prefill finishes the remainder once the
+        pages land.  Unlike the PD paths this never carries the first
+        generated token (the prefill produces it), and unlike
+        ``_validate_kv_meta`` the slab's n_tokens is expected to be
+        SMALLER than the prompt.  Any transfer failure — transient or
+        permanent — falls back to a full local prefill; the pool is an
+        optimization, never a correctness dependency."""
+        from kaito_tpu.engine.pd import ChunkedImport
+
+        self._validate_submit(prompt_tokens, params)
+        if adapter and adapter not in self.adapter_index:
+            raise ValueError(f"unknown adapter {adapter!r}")
+        if meta.get("model") not in ("", None, self.md.name):
+            raise ValueError(f"KV pool model mismatch: {meta.get('model')} "
+                             f"!= {self.md.name}")
+        wire_dt = meta.get("dtype")
+        if wire_dt is not None \
+                and np.dtype(wire_dt) != np.dtype(self.cache.k.dtype):
+            raise ValueError(f"KV pool dtype mismatch: wire {wire_dt} vs "
+                             f"pool {np.dtype(self.cache.k.dtype).name}")
+        ps = self.cfg.page_size
+        if not (0 < n_prefix_tokens < len(prompt_tokens)
+                and n_prefix_tokens % ps == 0):
+            raise ValueError(
+                f"prefix token count {n_prefix_tokens} must be a positive "
+                f"whole-page multiple below the prompt length "
+                f"{len(prompt_tokens)}")
+        rid = req_id or f"kvp-{self.counters['requests_total']}"
+        t, prio = self._resolve_qos(tenant, priority)
+        req = Request(rid,
+                      list(prompt_tokens), params, adapter=adapter,
+                      kv_chunked=ChunkedImport(meta, list(plans), -1,
+                                               deadline_s=deadline_s),
+                      kv_prefix_tokens=n_prefix_tokens,
+                      deadline=self._deadline_for(timeout_s),
+                      trace_id=trace_id or rid,
+                      tenant=t, priority=prio,
+                      pool_blocks=list(pool_blocks or []))
         self._enqueue(req)
         return req
 
@@ -2078,7 +2159,14 @@ class InferenceEngine:
                     FAILPOINTS.fire("engine.kv_import", req_id=req.req_id)
                     if ci.assemble():
                         did = True
-                    if ci.complete:
+                    if ci.complete and req.kv_prefix_tokens > 0:
+                        # cluster-KV-pool fetch: only a PREFIX of the
+                        # prompt's KV arrived — scatter it and hand the
+                        # slot back to the prefill machinery for the
+                        # remainder (docs/kv-pool.md)
+                        self._finish_prefix_import(i, ci)
+                        did = True
+                    elif ci.complete:
                         n = len(req.prompt_tokens)
                         n_pages = -(-n // self.cfg.page_size)
                         with self.tracer.span("kv.import.chunked",
@@ -2097,7 +2185,18 @@ class InferenceEngine:
                     transient = False
             if err is not None:
                 self._evict_slot(i, commit=False)
-                if transient and req.kv_retries > 0:
+                if req.kv_prefix_tokens > 0:
+                    # the pool is an optimization, never a correctness
+                    # dependency: ANY fetch failure (transient or not)
+                    # falls back to a full local prefill — the request
+                    # still succeeds, just at cold TTFT
+                    req.kv_chunked = None
+                    req.kv_prefix_tokens = 0
+                    self.counters["kv_pool_fetch_failures_total"] += 1
+                    logger.warning("KV pool fetch for %s failed (%s); "
+                                   "recomputing locally", req.req_id, err)
+                    self._requeue_front(req)
+                elif transient and req.kv_retries > 0:
                     # retry budget: fall back to LOCAL recompute — the
                     # request still succeeds (slower), and the prompt
                     # tokens are all here.  Clearing kv_chunked routes
@@ -2117,6 +2216,74 @@ class InferenceEngine:
                                        message=f"KV import failed: {err}")
                 did = True
         return did
+
+    def _finish_prefix_import(self, i: int, ci) -> None:
+        """Scatter a completed cluster-pool PREFIX fetch and hand the
+        slot back to the prefill machinery for the unfetched remainder.
+        The fetched slab may cover more pages than were verified
+        against this request's tokens — only the verified whole-page
+        prefix is imported."""
+        from kaito_tpu.engine.pd import import_arrays
+
+        slot = self.slots[i]
+        req = slot.request
+        ps = self.cfg.page_size
+        n_use = req.kv_prefix_tokens // ps
+        arrs = ci.full_arrays()
+        # contiguous COPIES, not views: a view would pin the full
+        # assembly buffers for as long as the replicated store entry
+        # lives
+        k = np.ascontiguousarray(arrs[0][:, :n_use])
+        v = np.ascontiguousarray(arrs[1][:, :n_use])
+        ks = vs = None
+        if len(arrs) == 4:
+            ks = np.ascontiguousarray(arrs[2][:, :n_use])
+            vs = np.ascontiguousarray(arrs[3][:, :n_use])
+        # pad the scatter to the next power of two by REPEATING the last
+        # page (same index, same bytes — an idempotent overwrite): the
+        # scatter's XLA program is shaped by the page count, and pool
+        # prefixes have arbitrary lengths, so unpadded imports would
+        # recompile per distinct count and eat the TTFT the fetch saved
+        pages = list(slot.pages[:n_use])
+        kp, vp, ksp, vsp = k, v, ks, vs
+        n_pad = 1 << max(0, n_use - 1).bit_length()
+        if n_pad > n_use:
+            reps = n_pad - n_use
+            pages += [pages[-1]] * reps
+
+            def _pad(a):
+                return np.concatenate(
+                    [a, np.repeat(a[:, -1:], reps, axis=1)], axis=1)
+            kp, vp = _pad(k), _pad(v)
+            if ks is not None:
+                ksp, vsp = _pad(ks), _pad(vs)
+        with self.tracer.span("kv.pool.import", req.trace_id, pages=n_use):
+            self.cache = import_arrays(self.cache, pages, kp, vp, ksp, vsp)
+        slot.importing = False
+        # _admit staged the prefill fields already (exclusive acquire,
+        # prefill_pos = 0); skipping ahead makes _advance_prefills run
+        # only the remainder — warm TTFT on a replica that never saw
+        # this prefix before
+        slot.prefill_pos = max(slot.prefill_pos, req.kv_prefix_tokens)
+        self.counters["kv_pool_fetches_total"] += 1
+        self.counters["kv_pool_fetched_tokens_total"] += req.kv_prefix_tokens
+        # replicate into the local store: this replica becomes a holder
+        # too, so the pool heals toward N copies and survives the
+        # ORIGINAL holder scaling down (docs/kv-pool.md)
+        if self.kv_pool is not None and len(req.pool_blocks) >= n_use:
+            from kaito_tpu.engine.kv_pool import (HostExport, PoolEntry,
+                                                  meta_nbytes, pool_key)
+
+            blocks = list(req.pool_blocks[:n_use])
+            key = pool_key(blocks)
+            if not self.kv_pool.has(key):
+                exp = HostExport(k, v, ks, vs, n_tokens=n_use * ps,
+                                 model=self.md.name,
+                                 prompt_tokens=req.prompt_tokens[:n_use * ps])
+                self.kv_pool.put(PoolEntry(
+                    key=key, blocks=blocks, n_tokens=n_use * ps,
+                    n_pages=n_use, export=exp,
+                    nbytes=meta_nbytes(exp.meta)))
 
     def _advance_prefills(self) -> bool:
         """Run ONE bounded prefill chunk for one staged slot
@@ -2297,6 +2464,8 @@ class InferenceEngine:
         req.kv_import = None     # imported KV is consumed; resume recomputes
         req.kv_chunked = None
         req.kv_device = None
+        req.kv_prefix_tokens = 0  # pool fetch (if any) is spent; resume
+        # takes the normal prefill path
         if not will_requeue:
             # the sequence already fills the whole pool: it cannot be
             # re-admitted (resume needs more pages than exist), and all
@@ -3107,9 +3276,57 @@ class InferenceEngine:
                         prompt_tokens=list(req.prompt_tokens),
                         first_token=req.output_tokens[0], lazy_drain=True,
                         trace_id=req.trace_id))
+            if self.kv_pool is not None:
+                # publish BEFORE _evict_slot: the gather needs the
+                # slot's page ids while they still belong to this
+                # request (the gather copies, so release is safe after)
+                try:
+                    self._publish_prefix(slot_idx)
+                except Exception:
+                    # publishing is an optimization; a failure must
+                    # never take the finished request down with it
+                    logger.exception("KV pool publish failed for %s",
+                                     req.req_id)
             self._finish_trace(req)
             req.out.put(None)
             if self.host_kv is not None:
                 self.host_kv.discard(req.req_id)
             self._evict_slot(slot_idx, commit=True)
             self.counters["requests_finished_total"] += 1
+
+    def _publish_prefix(self, slot_idx: int) -> None:
+        """Publish a finished request's whole-page prompt-prefix KV
+        into the replica-local pool store (docs/kv-pool.md).  Engine
+        thread does only the on-device gather (stage_export); the D2H
+        drain runs on the staged export's background copier.  Adapter
+        requests never publish (their KV is adapter-flavored — another
+        replica would serve base-model requests from it)."""
+        from kaito_tpu.engine.kv_pool import PoolEntry, meta_nbytes, pool_key
+        from kaito_tpu.engine.pd import stage_export
+
+        slot = self.slots[slot_idx]
+        req = slot.request
+        if not req.pool_blocks or req.adapter:
+            return
+        ps = self.cfg.page_size
+        # whole pages only, and never more pages than hash blocks: the
+        # advert pairs page i with block hash i, so an unhashed tail
+        # page would be unreachable anyway
+        n_pages = min(len(req.prompt_tokens) // ps, len(req.pool_blocks))
+        min_tok = self.cfg.kv_pool_min_tokens or ps
+        if n_pages * ps < min_tok:
+            return
+        blocks = list(req.pool_blocks[:n_pages])
+        key = pool_key(blocks)
+        if self.kv_pool.has(key):
+            return
+        with self.tracer.span("kv.pool.publish", req.trace_id,
+                              pages=n_pages):
+            exp = stage_export(self.cache, slot.pages[:n_pages],
+                               n_tokens=n_pages * ps, model=self.md.name,
+                               prompt_tokens=req.prompt_tokens[:n_pages * ps],
+                               first_token=-1, trace_id=req.trace_id)
+        self.kv_pool.put(PoolEntry(key=key, blocks=blocks,
+                                   n_tokens=n_pages * ps, n_pages=n_pages,
+                                   export=exp, nbytes=meta_nbytes(exp.meta)))
+        self.counters["kv_pool_published_total"] += 1

@@ -88,6 +88,8 @@ class HostKVPool:
         self.spilled_pages = 0
         self.restored_pages = 0
         self.evicted_entries = 0
+        self.hits = 0       # pop() found the spilled entry
+        self.misses = 0     # pop() came up empty (evicted/never spilled)
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -143,6 +145,9 @@ class HostKVPool:
         if entry is not None:
             self.used_bytes -= entry.nbytes
             self.restored_pages += entry.n_pages
+            self.hits += 1
+        else:
+            self.misses += 1
         return entry
 
     def discard(self, req_id: str) -> None:

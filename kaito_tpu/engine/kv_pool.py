@@ -1,0 +1,224 @@
+"""Cluster-wide KV pool, replica-local side (docs/kv-pool.md).
+
+Every replica keeps a byte-budgeted LRU of finished prompt prefixes —
+whole-page KV slabs staged by the existing PD export machinery — keyed
+by the SAME chained FNV-1a block hashes the EPP computes over request
+bodies (``runtime/routing.prefix_blocks``).  The store is served over
+the chunked PD wire (``/kv_pool/<key>/meta`` + ``/chunk/<i>``), and its
+key set is advertised at ``/debug/kv_pool`` for the EPP's cluster-wide
+prefix→holder index.  A freshly scaled-up replica can therefore fetch a
+prefix another replica warmed instead of recomputing it, so warm TTFT
+survives scale-out, rollout, and failover.
+
+Correctness model: the block hashes are an INDEX, never an authority.
+Chat templates, tokenizer boundary effects, and hash collisions all
+mean a char-block match does not prove a token-level match — so the
+pool meta response carries the entry's exact ``prompt_tokens`` and the
+fetching engine trims to the longest common whole-page token prefix
+before importing (``common_prefix_pages``).  Any miss, eviction, or
+transfer failure degrades to the local prefill the scheduler already
+has; the pool can only ever remove work, never corrupt it.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from kaito_tpu.engine.pd import plan_chunks, serialize_chunk
+from kaito_tpu.runtime.routing import prefix_blocks
+
+# one KV page of page_size tokens covers page_size * CHARS_PER_TOKEN
+# prompt chars — the same heuristic the EPP uses to align its block
+# size to the engine's page size (routing.CHARS_PER_TOKEN)
+CHARS_PER_TOKEN = 4
+_MASK64 = (1 << 64) - 1
+
+
+def pool_block_chars(page_size: int) -> int:
+    """Char-block size whose blocks line up 1:1 with KV pages."""
+    return page_size * CHARS_PER_TOKEN
+
+
+def prompt_pool_blocks(text: str, page_size: int) -> list[int]:
+    """The engine-side publisher's block hashes for a prompt.  MUST
+    stay the exact chain the EPP computes (``prefix_blocks`` at
+    ``kv_page_size * 4`` chars) — a silent divergence makes the global
+    index useless (pinned by tests/test_kv_pool.py)."""
+    return prefix_blocks(text, pool_block_chars(page_size))
+
+
+def pool_key(blocks: list[int]) -> str:
+    """Store key of a prefix: the chained hash of its LAST block (it
+    folds every earlier block, so it names the whole prefix)."""
+    return f"{blocks[-1] & _MASK64:016x}"
+
+
+def meta_nbytes(meta: dict) -> int:
+    """Host bytes a staged entry's chunks occupy once drained (K + V +
+    fp32 scale slabs for int8 pools), from the wire meta alone."""
+    dt = np.dtype(meta["dtype"])
+    n = int(np.prod(meta["shape"])) * dt.itemsize
+    n += int(np.prod(meta.get("v_shape", meta["shape"]))) * dt.itemsize
+    if "ks_shape" in meta:
+        n += (int(np.prod(meta["ks_shape"]))
+              + int(np.prod(meta["vs_shape"]))) * 4
+    return n
+
+
+class HostExport:
+    """A StagedExport-shaped serving surface over HOST arrays.
+
+    After a fetch, the target replica replicates the imported prefix
+    into its own store (so the pool heals toward N holders and the
+    original holder can scale down without losing the prefix).  The
+    assembled host slab is what it has; this wraps it with the same
+    ``meta``/``plans``/``get_chunk`` surface the pool endpoints serve,
+    serializing chunks on demand so the bytes aren't stored twice."""
+
+    def __init__(self, k: np.ndarray, v: np.ndarray,
+                 ks: Optional[np.ndarray] = None,
+                 vs: Optional[np.ndarray] = None, *,
+                 n_tokens: int, model: str, prompt_tokens: list[int]):
+        self._k, self._v, self._ks, self._vs = k, v, ks, vs
+        L, n_pages = int(k.shape[0]), int(k.shape[1])
+        per_layer_page = int(np.prod(k.shape[2:])
+                             + np.prod(v.shape[2:])) * k.dtype.itemsize
+        if ks is not None:
+            per_layer_page += int(np.prod(ks.shape[2:])
+                                  + np.prod(vs.shape[2:])) * 4
+        self.plans = plan_chunks(L, n_pages, per_layer_page)
+        self.meta = {"shape": [int(s) for s in k.shape],
+                     "v_shape": [int(s) for s in v.shape],
+                     "dtype": str(k.dtype), "n_tokens": n_tokens,
+                     "model": model,
+                     "chunks": [p.to_json() for p in self.plans]}
+        if ks is not None:
+            self.meta["ks_shape"] = [int(s) for s in ks.shape]
+            self.meta["vs_shape"] = [int(s) for s in vs.shape]
+        self.prompt_tokens = list(prompt_tokens)
+        self.first_token = -1
+
+    @property
+    def n_chunks(self) -> int:
+        return len(self.plans)
+
+    def ensure_draining(self) -> None:
+        """Parity with StagedExport — the bytes are already on host."""
+
+    def get_chunk(self, i: int, timeout: float = 60.0,
+                  consume: bool = False) -> bytes:
+        if not 0 <= i < len(self.plans):
+            raise IndexError(f"chunk {i} out of range ({len(self.plans)})")
+        p = self.plans[i]
+        k = self._k[p.layer_lo:p.layer_hi, p.page_lo:p.page_hi]
+        v = self._v[p.layer_lo:p.layer_hi, p.page_lo:p.page_hi]
+        ks = vs = None
+        if self._ks is not None:
+            ks = self._ks[p.layer_lo:p.layer_hi, p.page_lo:p.page_hi]
+            vs = self._vs[p.layer_lo:p.layer_hi, p.page_lo:p.page_hi]
+        return serialize_chunk(np.ascontiguousarray(k),
+                               np.ascontiguousarray(v), ks, vs)
+
+
+@dataclass
+class PoolEntry:
+    """One published prefix: whole pages only, tokens are authoritative."""
+
+    key: str
+    blocks: list[int]          # chained block hashes, one per KV page
+    n_tokens: int              # == n_pages * page_size
+    n_pages: int
+    export: object             # StagedExport or HostExport
+    nbytes: int
+    created: float = field(default_factory=time.monotonic)
+
+
+class PrefixPageStore:
+    """Byte-budgeted thread-safe LRU of published prefixes, keyed by
+    ``pool_key``.  Dropping an entry is always safe — the fetch path
+    treats a 410 exactly like a miss and recomputes locally."""
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = max_bytes
+        self.used_bytes = 0
+        self._entries: "collections.OrderedDict[str, PoolEntry]" = \
+            collections.OrderedDict()
+        self._lock = threading.Lock()
+        self.published_total = 0
+        self.evictions_total = 0
+        self.hits_total = 0          # get() served a fetch
+        self.misses_total = 0        # get() came up empty (evicted/never had)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def put(self, entry: PoolEntry) -> bool:
+        """Publish; returns False if the entry can never fit."""
+        if entry.nbytes > self.max_bytes:
+            return False
+        with self._lock:
+            old = self._entries.pop(entry.key, None)
+            if old is not None:
+                self.used_bytes -= old.nbytes
+            while (self.used_bytes + entry.nbytes > self.max_bytes
+                   and self._entries):
+                _, victim = self._entries.popitem(last=False)
+                self.used_bytes -= victim.nbytes
+                self.evictions_total += 1
+            self._entries[entry.key] = entry
+            self.used_bytes += entry.nbytes
+            self.published_total += 1
+        return True
+
+    def get(self, key: str) -> Optional[PoolEntry]:
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                self.misses_total += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits_total += 1
+            return entry
+
+    def peek(self, key: str) -> Optional[PoolEntry]:
+        """Lookup WITHOUT hit/miss accounting or LRU touch — chunk
+        pulls of an already-claimed fetch must not inflate the hit
+        rate (one fetch = one hit, counted at the meta handshake)."""
+        with self._lock:
+            return self._entries.get(key)
+
+    def has(self, key: str) -> bool:
+        with self._lock:
+            return key in self._entries
+
+    def advert(self) -> list[dict]:
+        """The holder's index advert, freshest last-used first: key +
+        per-page block-hash chain (hex — JSON numbers lose 64-bit
+        precision) + token count, enough for the EPP to match request
+        prefixes without ever seeing KV bytes."""
+        with self._lock:
+            entries = list(self._entries.values())
+        return [{"key": e.key,
+                 "blocks": [f"{b & _MASK64:016x}" for b in e.blocks],
+                 "n_tokens": e.n_tokens}
+                for e in reversed(entries)]
+
+
+def common_prefix_pages(req_tokens: list[int], entry_tokens: list[int],
+                        page_size: int) -> int:
+    """Whole pages of ``entry_tokens`` that are a verified token-level
+    prefix of ``req_tokens`` — capped below the full request so at
+    least one token remains for the prefill to produce logits from.
+    This, not the hash match, is the import authority."""
+    limit = min(len(req_tokens) - 1, len(entry_tokens))
+    n = 0
+    while n < limit and req_tokens[n] == entry_tokens[n]:
+        n += 1
+    return n // page_size

@@ -373,6 +373,59 @@ class EngineMetrics:
                   "Bytes held by the host KV offload tier", r,
                   fn=lambda: engine.host_kv.used_bytes
                   if engine.host_kv else 0)
+            # host-tier effectiveness split (folded into fleet
+            # aggregates by runtime/fleet.py): entries + hit/miss lets
+            # a rollup compute a cluster-wide host-tier hit rate, and
+            # evictions tells capacity pressure from churn
+            Gauge("kaito:host_kv_entries",
+                  "Sequences parked in the host KV offload tier", r,
+                  fn=lambda: len(engine.host_kv) if engine.host_kv else 0)
+            Gauge("kaito:host_kv_hits_total",
+                  "Host KV offload pops that found the sequence", r,
+                  fn=lambda: engine.host_kv.hits if engine.host_kv else 0)
+            Gauge("kaito:host_kv_misses_total",
+                  "Host KV offload pops that came up empty", r,
+                  fn=lambda: engine.host_kv.misses if engine.host_kv else 0)
+            Gauge("kaito:host_kv_evictions_total",
+                  "Entries LRU-evicted from the host KV offload tier", r,
+                  fn=lambda: engine.host_kv.evicted_entries
+                  if engine.host_kv else 0)
+            if getattr(engine, "kv_pool", None) is not None:
+                # cluster KV pool (docs/kv-pool.md): families exist
+                # ONLY with the pool enabled — collect() emits
+                # HELP/TYPE even for zero-valued series, and the
+                # pool-off exposition must stay byte-identical
+                pool = engine.kv_pool
+                Gauge("kaito:kv_pool_entries",
+                      "Prefix entries in the cluster KV pool store", r,
+                      fn=lambda: len(pool))
+                Gauge("kaito:kv_pool_bytes_used",
+                      "Host bytes held by the cluster KV pool store", r,
+                      fn=lambda: pool.used_bytes)
+                Gauge("kaito:kv_pool_published_total",
+                      "Prefix entries published to the pool store", r,
+                      fn=lambda: pool.published_total)
+                Gauge("kaito:kv_pool_evictions_total",
+                      "Prefix entries LRU-evicted from the pool store", r,
+                      fn=lambda: pool.evictions_total)
+                Gauge("kaito:kv_pool_hits_total",
+                      "Pool fetch handshakes served from the store", r,
+                      fn=lambda: pool.hits_total)
+                Gauge("kaito:kv_pool_misses_total",
+                      "Pool fetch handshakes that missed (evicted)", r,
+                      fn=lambda: pool.misses_total)
+                Gauge("kaito:kv_pool_fetches_total",
+                      "Cross-replica prefix fetches imported", r,
+                      fn=lambda: engine.counters.get(
+                          "kv_pool_fetches_total", 0))
+                Gauge("kaito:kv_pool_fetched_tokens_total",
+                      "Prompt tokens imported via cross-replica fetch", r,
+                      fn=lambda: engine.counters.get(
+                          "kv_pool_fetched_tokens_total", 0))
+                Gauge("kaito:kv_pool_fetch_failures_total",
+                      "Prefix fetches that fell back to local recompute",
+                      r, fn=lambda: engine.counters.get(
+                          "kv_pool_fetch_failures_total", 0))
             Gauge("kaito:pd_device_handoffs_total",
                   "Colocated device-to-device KV hand-offs", r,
                   fn=lambda: engine.counters.get(

@@ -287,6 +287,9 @@ class StagedExport:
         self.prompt_tokens = prompt_tokens
         self.first_token = first_token
         self.created = time.monotonic()
+        # refreshed by KVExportRegistry.get() so a slow multi-chunk pull
+        # keeps the entry alive — TTL GC ages on this, not on `created`
+        self.last_access = self.created
         self._k_dev, self._v_dev = k_dev, v_dev
         self._ks_dev, self._vs_dev = ks_dev, vs_dev
         self._chunks: list[Optional[bytes]] = [None] * len(plans)
@@ -496,6 +499,8 @@ class KVExportRegistry:
             if exp is not None and exp.fully_served:
                 del self._items[req_id]
                 return None
+            if exp is not None:
+                exp.last_access = time.monotonic()
             return exp
 
     def pop(self, req_id: str) -> Optional[StagedExport]:
@@ -510,9 +515,11 @@ class KVExportRegistry:
                 del self._items[req_id]
 
     def _gc(self) -> None:
+        # age on last_access, not created: a multi-chunk pull slower
+        # than ttl_s would otherwise lose the entry between chunks
         now = time.monotonic()
         dead = [k for k, e in self._items.items()
-                if now - e.created > self.ttl_s]
+                if now - getattr(e, "last_access", e.created) > self.ttl_s]
         for k in dead:
             del self._items[k]
 

@@ -239,6 +239,17 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                 self._pd_kv_chunk(rid, idx)
             else:
                 self._pd_kv(rest)
+        elif self.path == "/debug/kv_pool":
+            self._kv_pool_advert()
+        elif self.path.startswith("/kv_pool/"):
+            rest = self.path[len("/kv_pool/"):]
+            if rest.endswith("/meta"):
+                self._kv_pool_meta(rest[:-len("/meta")])
+            elif "/chunk/" in rest:
+                key, _, idx = rest.partition("/chunk/")
+                self._kv_pool_chunk(key, idx)
+            else:
+                self._error(404, f"no route {self.path}")
         elif self.path in ("/ui", "/ui/"):
             # single-pod demo: the DemoUI chat page served in-process
             # (the standalone proxy pod lives in kaito_tpu/ui)
@@ -534,6 +545,168 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             reg.put(req_id, exp)
             raise
         reg.drop_served(req_id)
+
+    # ---------------- cluster-wide KV pool (docs/kv-pool.md) ----------
+
+    def _kv_pool(self):
+        """The replica-local prefix store, or None when the feature is
+        off (every pool route 403s then — with the pool disabled the
+        server's observable surface is byte-identical to before)."""
+        return getattr(self.state.engine, "kv_pool", None)
+
+    def _kv_pool_advert(self):
+        """Holder advert for the EPP's cluster-wide prefix→holder
+        index: the store's key set with per-page block-hash chains.
+        Metadata only — KV bytes move exclusively over the chunked
+        wire below."""
+        pool = self._kv_pool()
+        if pool is None:
+            return self._error(403, "KV pool disabled on this pod")
+        from kaito_tpu.engine.kv_pool import pool_block_chars
+
+        ps = self.state.engine.cfg.page_size
+        self._json(200, {"enabled": True, "page_size": ps,
+                         "block_chars": pool_block_chars(ps),
+                         "entries": pool.advert()})
+
+    def _kv_pool_meta(self, key: str):
+        """Fetch handshake: chunk plans plus the entry's EXACT prompt
+        tokens — the fetcher trims to the longest common whole-page
+        token prefix before importing (hashes index, tokens decide).
+        A dropped entry is a 404 the fetcher treats as a miss."""
+        pool = self._kv_pool()
+        if pool is None:
+            return self._error(403, "KV pool disabled on this pod")
+        entry = pool.get(key)
+        if entry is None:
+            return self._error(404, f"no pool entry {key}")
+        exp = entry.export
+        exp.ensure_draining()
+        self._json(200, {"meta": exp.meta, "n_chunks": exp.n_chunks,
+                         "n_tokens": entry.n_tokens,
+                         "prompt_tokens": list(exp.prompt_tokens)})
+
+    def _kv_pool_chunk(self, key: str, idx: str):
+        """Pull ONE chunk of a pool entry over the same wire format as
+        the PD hand-off.  NEVER consumed: unlike a PD export (one
+        producer, one consumer) a pool entry serves arbitrarily many
+        fetches until the LRU evicts it."""
+        pool = self._kv_pool()
+        if pool is None:
+            return self._error(403, "KV pool disabled on this pod")
+        entry = pool.peek(key)
+        if entry is None:
+            return self._error(410, f"pool entry {key} dropped")
+        try:
+            data = entry.export.get_chunk(int(idx), consume=False)
+        except (IndexError, ValueError) as e:
+            return self._error(400, str(e))
+        except Exception as e:
+            return self._error(500, f"chunk read failed: {e}")
+        self.send_response(200)
+        self.send_header("Content-Type", "application/octet-stream")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _submit_with_pool_fetch(self, url: str, key: str,
+                                tokens: list, params, *,
+                                timeout_s: float = 0.0, tenant: str = "",
+                                priority: str = "", pool_blocks=None):
+        """Cluster-pool fetch: the EPP picked THIS replica but told us
+        (X-Kaito-KV-Fetch headers) that a peer holds the prompt's
+        prefix KV.  Pull it over the chunked wire and prefill only the
+        remainder.  Returns None on ANY ineligibility or failure — the
+        caller falls back to a plain submit; the pool is an
+        optimization, never a correctness dependency."""
+        import urllib.request
+
+        from kaito_tpu.engine.kv_pool import common_prefix_pages
+        from kaito_tpu.engine.pd import ChunkPlan, should_transfer
+
+        eng = self.state.engine
+        url = url.rstrip("/")
+        # same trust boundary as PD pulls: the allowlist (when set)
+        # bounds whose bytes may enter this engine's KV pool
+        allow = [p for p in self.state.cfg.pd_source_allowlist.split(",")
+                 if p]
+        if allow and not any(url.startswith(pref) for pref in allow):
+            logger.info("kv_pool fetch source %s not in allowlist", url)
+            return None
+        try:
+            with urllib.request.urlopen(f"{url}/kv_pool/{key}/meta",
+                                        timeout=10) as r:
+                hs = json.loads(r.read())
+            meta = hs["meta"]
+            plans = [ChunkPlan.from_json(c) for c in meta["chunks"]]
+            entry_tokens = hs.get("prompt_tokens") or []
+        except Exception as e:
+            logger.info("kv_pool meta pull from %s failed: %s", url, e)
+            return None
+        ps = eng.cfg.page_size
+        # token-level verification: the block hashes only INDEXED this
+        # entry; what gets imported is decided by comparing real tokens
+        n_pages = common_prefix_pages(tokens, entry_tokens, ps)
+        if n_pages <= 0:
+            return None
+        n_prefix = n_pages * ps
+        # the EPP already modeled transfer-vs-recompute with fleet
+        # knowledge; the engine vetoes only when its own MEASURED rates
+        # disagree (a fresh replica has none — exactly the scale-out
+        # case the pool exists for)
+        costs = getattr(eng, "pd_costs", None)
+        snap = costs.snapshot() if costs is not None else {}
+        if snap.get("net_bytes_s") and snap.get("prefill_tok_s"):
+            cache = getattr(eng, "cache", None)
+            kv_itemsize = cache.k.dtype.itemsize if cache is not None else 2
+            scale_bpt = 0.0
+            if cache is not None \
+                    and getattr(cache, "k_scale", None) is not None:
+                arch = eng.md.arch
+                scale_bpt = (8.0 * arch.num_layers * arch.num_kv_heads
+                             / max(1, ps))
+            if not should_transfer(n_prefix, eng.md.arch, kv_itemsize,
+                                   scale_bytes_per_token=scale_bpt,
+                                   measured=costs):
+                logger.info("kv_pool fetch below measured break-even "
+                            "(%d tokens); recomputing locally", n_prefix)
+                return None
+        try:
+            req = eng.submit_with_kv_prefix(
+                tokens, meta, plans, n_prefix, params,
+                req_id=f"cmpl-{uuid.uuid4().hex[:20]}",
+                timeout_s=timeout_s, trace_id=self._rid,
+                tenant=tenant, priority=priority,
+                pool_blocks=pool_blocks)
+        except ValueError as e:
+            logger.info("kv_pool fetch submit rejected: %s", e)
+            return None
+
+        def pull():
+            ci = req.kv_chunked
+            try:
+                t0 = time.monotonic()
+                nbytes = 0
+                for i in range(len(plans)):
+                    with urllib.request.urlopen(
+                            f"{url}/kv_pool/{key}/chunk/{i}",
+                            timeout=60) as r:
+                        data = r.read()
+                    nbytes += len(data)
+                    ci.feed(i, data)
+                    eng._wake.set()
+                if costs is not None:
+                    costs.note_transfer(nbytes, time.monotonic() - t0)
+            except Exception as e:
+                # the engine's prefix-import error path converts ANY
+                # pool-fetch failure into a full local prefill
+                ci.set_error(f"pool chunk pull from {url} failed: {e}",
+                             transient=True)
+                eng._wake.set()
+
+        threading.Thread(target=pull, daemon=True,
+                         name="kv-pool-puller").start()
+        return req
 
     def _adopt_handoff_trace(self, meta: dict) -> None:
         """PD decode role: when the client sent no trace header, adopt
@@ -850,6 +1023,17 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         stop_strs = [stop] if isinstance(stop, str) else list(stop or [])
         tokens = st.engine.tokenizer.encode(prompt_text)
         kv_src = body.get("kv_transfer")
+        # cluster-wide KV pool (docs/kv-pool.md): hash the request the
+        # SAME way the EPP does (extract_prompt_text on the body, not
+        # the rendered template) so finished prefixes publish under
+        # exactly the hashes the fleet index computes
+        pool_blocks: list = []
+        if getattr(st.engine, "kv_pool", None) is not None:
+            from kaito_tpu.engine.kv_pool import prompt_pool_blocks
+            from kaito_tpu.runtime.routing import extract_prompt_text
+
+            pool_blocks = prompt_pool_blocks(extract_prompt_text(body),
+                                             st.engine.cfg.page_size)
         # per-request adapter routing: the "model" field selects a
         # discovered adapter, exactly like the reference serves adapters
         # as models (inference_api.py:417-498)
@@ -898,11 +1082,24 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                     return  # error already sent
                 tokens = req.prompt_tokens
             else:
-                req = st.engine.submit(tokens, params,
-                                       req_id=f"cmpl-{uuid.uuid4().hex[:20]}",
-                                       adapter=adapter, timeout_s=timeout_s,
-                                       trace_id=self._rid, tenant=tenant,
-                                       priority=priority)
+                req = None
+                fetch_url = self.headers.get("X-Kaito-KV-Fetch", "")
+                fetch_key = self.headers.get("X-Kaito-KV-Fetch-Key", "")
+                if (getattr(st.engine, "kv_pool", None) is not None
+                        and fetch_url and fetch_key and not adapter):
+                    # the EPP routed here with a fetch hint: a peer
+                    # replica holds this prompt's prefix KV
+                    req = self._submit_with_pool_fetch(
+                        fetch_url, fetch_key, tokens, params,
+                        timeout_s=timeout_s, tenant=tenant,
+                        priority=priority, pool_blocks=pool_blocks)
+                if req is None:
+                    req = st.engine.submit(
+                        tokens, params,
+                        req_id=f"cmpl-{uuid.uuid4().hex[:20]}",
+                        adapter=adapter, timeout_s=timeout_s,
+                        trace_id=self._rid, tenant=tenant,
+                        priority=priority, pool_blocks=pool_blocks)
         except ValueError as e:
             return self._error(400, str(e))
 
@@ -1267,6 +1464,17 @@ def main(argv=None):
                     default=os.environ.get("KAITO_PD_ENABLED", "") == "true")
     ap.add_argument("--pd-source-allowlist",
                     default=os.environ.get("KAITO_PD_ALLOWLIST", ""))
+    ap.add_argument("--kv-pool", action="store_true",
+                    default=os.environ.get("KAITO_KV_POOL", "") == "true",
+                    help="cluster-wide KV pool (docs/kv-pool.md): publish "
+                         "finished prompt prefixes for cross-replica fetch "
+                         "and serve them over the chunked PD wire "
+                         "(default off; off keeps behavior and /metrics "
+                         "byte-identical)")
+    ap.add_argument("--kv-pool-bytes", type=int,
+                    default=int(os.environ.get("KAITO_KV_POOL_BYTES",
+                                               str(1 << 30))),
+                    help="host bytes for the replica-local prefix store")
     ap.add_argument("--kaito-disable-rate-limit", action="store_true")
     ap.add_argument("--enable-prefix-caching", dest="enable_prefix_caching",
                     action="store_true", default=True,
@@ -1354,6 +1562,8 @@ def main(argv=None):
         quantization=args.quantization,
         pd_enabled=args.pd_enabled,
         pd_source_allowlist=args.pd_source_allowlist,
+        kv_pool_enabled=args.kv_pool,
+        kv_pool_bytes=args.kv_pool_bytes,
         disable_rate_limit=args.kaito_disable_rate_limit,
         enable_prefix_caching=args.enable_prefix_caching,
         host_kv_offload_bytes=int(

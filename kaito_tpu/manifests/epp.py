@@ -26,11 +26,15 @@ LABEL_EPP = "kaito-tpu.io/epp"
 def build_epp_command(backends: list[str], *,
                       plugins_config: Optional[dict] = None,
                       block_chars: int = 0,
-                      draining: Optional[list[str]] = None) -> list[str]:
+                      draining: Optional[list[str]] = None,
+                      kv_pool: bool = False) -> list[str]:
     """The container command: one ``--backend`` per replica spec
     (``url[=role[/group]]``), the plugin chain inline as JSON, and one
     ``--drain-backend`` per replica the autoscaler is retiring (the
-    picker keeps relaying its in-flight work but stops scoring it)."""
+    picker keeps relaying its in-flight work but stops scoring it).
+    ``kv_pool`` mirrors the engines' ``kaito-tpu.io/kv-pool``
+    annotation: the picker scrapes holder adverts and emits fetch
+    hints only when the replicas actually publish (docs/kv-pool.md)."""
     cmd = ["python", "-m", "kaito_tpu.runtime.epp",
            "--port", str(EPP_PORT)]
     for spec in backends:
@@ -42,6 +46,8 @@ def build_epp_command(backends: list[str], *,
                 json.dumps(plugins_config, sort_keys=True)]
     if block_chars:
         cmd += ["--block-chars", str(block_chars)]
+    if kv_pool:
+        cmd += ["--kv-pool"]
     return cmd
 
 
@@ -50,6 +56,7 @@ def generate_epp_workload(name: str, namespace: str, *,
                           owner: Optional[dict] = None,
                           plugins_config: Optional[dict] = None,
                           draining: Optional[list[str]] = None,
+                          kv_pool: bool = False,
                           image: str = DEFAULT_IMAGE) -> list:
     """Render the ``<name>`` (conventionally ``<cr>-epp``) Deployment +
     Service the InferencePool's extensionRef resolves to."""
@@ -70,7 +77,7 @@ def generate_epp_workload(name: str, namespace: str, *,
                         "image": image,
                         "command": build_epp_command(
                             backends, plugins_config=plugins_config,
-                            draining=draining),
+                            draining=draining, kv_pool=kv_pool),
                         "ports": [{"containerPort": EPP_PORT}],
                         "readinessProbe": {
                             "httpGet": {"path": "/router/stats",

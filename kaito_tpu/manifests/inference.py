@@ -71,6 +71,16 @@ def build_engine_command(
     qos = ws.metadata.annotations.get("kaito-tpu.io/qos", "")
     if qos:
         args += ["--qos-config", qos]
+    # cluster KV pool (docs/kv-pool.md): opt-in per workspace; the
+    # controller mirrors the same annotation onto the EPP deployment so
+    # holder adverts and fetch hints switch on together
+    kv_pool = ws.metadata.annotations.get("kaito-tpu.io/kv-pool", "")
+    if kv_pool.lower() in ("true", "1", "on", "enabled"):
+        args += ["--kv-pool"]
+        pool_bytes = ws.metadata.annotations.get(
+            "kaito-tpu.io/kv-pool-bytes", "")
+        if pool_bytes:
+            args += ["--kv-pool-bytes", pool_bytes]
     spec_draft = ws.metadata.annotations.get(
         "kaito-tpu.io/speculative-draft", "")
     if spec_draft:

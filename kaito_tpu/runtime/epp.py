@@ -28,6 +28,7 @@ The picker exports its own ``kaito:epp_*`` series next to the shared
 from __future__ import annotations
 
 import argparse
+import http.client
 import json
 import logging
 import signal
@@ -37,7 +38,8 @@ from typing import Iterable, Optional
 from kaito_tpu.engine.metrics import Counter, Gauge, Registry
 from kaito_tpu.engine.qos import priority_rank
 from kaito_tpu.runtime.routing import (Backend, PrefixAffinityIndex,
-                                       RoutingCore, _MASK64, _fnv1a,
+                                       RoutingCore, _BackendPoller, _MASK64,
+                                       _fnv1a, extract_prompt_text,
                                        make_routing_server, prefix_blocks)
 
 logger = logging.getLogger(__name__)
@@ -52,6 +54,122 @@ DEFAULT_BLOCK_CHARS = 64       # engine default page_size=16 tokens * 4
 
 # score weight that dominates load terms when most prefix blocks match
 AFFINITY_WEIGHT = 3.0
+
+# cluster KV-pool locality weight: below AFFINITY_WEIGHT (a radix-tree
+# hit on the picked replica beats a cross-replica fetch) but above the
+# load terms, so a healthy holder wins ties against equally-loaded peers
+POOL_WEIGHT = 2.5
+
+
+class KVPoolIndex:
+    """Cluster-wide prefix→holder lookup (docs/kv-pool.md).
+
+    Built from the ``/debug/kv_pool`` adverts each replica serves:
+    every advertised entry contributes one index row PER BLOCK HASH in
+    its chain, so a request matching only the first half of a long
+    published prefix still finds the holder.  Because the hashes are
+    chained (block *i* folds every earlier block), equality at position
+    *i* implies — up to hash collision, which the ENGINE's token-level
+    trim makes harmless — that the whole *i+1*-block prefix matches.
+    Rows are keyed by (block_chars, hash) so adverts from replicas
+    configured with a different page size can never cross-match."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._adverts: dict[str, dict] = {}     # url -> parsed advert
+        # (block_chars, hash hex) -> url -> (entry key, n_pages, n_tokens)
+        self._index: dict = {}
+        self.updates = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._index)
+
+    def update(self, url: str, advert: Optional[dict]) -> None:
+        """Replace one replica's advert (None/empty/disabled = forget
+        it — a scrape failure or a rollout restart must not leave
+        stale holders steering fetches at a replica without the KV;
+        the fetch path degrades to recompute anyway, this just keeps
+        the hint hit rate honest)."""
+        with self._lock:
+            if (isinstance(advert, dict) and advert.get("enabled")
+                    and advert.get("entries")):
+                self._adverts[url] = {
+                    "block_chars": int(advert.get("block_chars") or 0),
+                    "entries": advert["entries"]}
+            else:
+                self._adverts.pop(url, None)
+            self._rebuild_locked()
+            self.updates += 1
+
+    def drop(self, url: str) -> None:
+        self.update(url, None)
+
+    def _rebuild_locked(self) -> None:
+        idx: dict = {}
+        for url, adv in self._adverts.items():
+            bc = adv["block_chars"]
+            for e in adv["entries"]:
+                blocks = e.get("blocks") or []
+                key = str(e.get("key") or "")
+                n_tokens = int(e.get("n_tokens") or 0)
+                if not blocks or not key:
+                    continue
+                for i, h in enumerate(blocks):
+                    holders = idx.setdefault((bc, str(h)), {})
+                    cur = holders.get(url)
+                    # same hash can appear in several entries (shared
+                    # prefixes): keep the one serving the most pages
+                    if cur is None or i + 1 > cur[1]:
+                        holders[url] = (key, i + 1, n_tokens)
+        self._index = idx
+
+    def match(self, blocks: list[int],
+              block_chars: int) -> dict[str, tuple]:
+        """url -> (entry key, matched pages, entry tokens) for the
+        LONGEST advertised prefix of ``blocks`` — scan from the tail so
+        the first hit is the best one."""
+        hexes = [f"{b & _MASK64:016x}" for b in blocks]
+        with self._lock:
+            for i in range(len(hexes) - 1, -1, -1):
+                holders = self._index.get((block_chars, hexes[i]))
+                if holders:
+                    return dict(holders)
+        return {}
+
+
+class KVPoolScraper(_BackendPoller):
+    """Background ``/debug/kv_pool`` advert scrape per backend: keeps
+    the cluster prefix→holder index fresh without spending a request
+    round trip.  A 403 (pool disabled), connect failure, or garbage
+    body clears that replica's rows."""
+
+    def __init__(self, picker: "EndpointPicker", interval_s: float = 2.0,
+                 timeout_s: float = 2.0):
+        super().__init__("epp-kv-pool-scraper", interval_s)
+        self.picker = picker
+        self.timeout_s = timeout_s
+
+    def targets(self) -> Iterable[Backend]:
+        return [b for b in self.picker.backends if b.alive]
+
+    def poll_one(self, b: Backend) -> None:
+        advert = None
+        try:
+            conn = http.client.HTTPConnection(b.host, b.port,
+                                              timeout=self.timeout_s)
+            try:
+                conn.request("GET", "/debug/kv_pool")
+                resp = conn.getresponse()
+                if resp.status == 200:
+                    advert = json.loads(resp.read().decode("utf-8",
+                                                           "replace"))
+            finally:
+                conn.close()
+        except (ConnectionError, OSError, ValueError):
+            advert = None
+        if self.picker.pool_index is not None:
+            self.picker.pool_index.update(b.url, advert)
 
 
 def default_epp_plugins_config() -> dict:
@@ -76,7 +194,7 @@ class RequestCtx:
     """Everything scoring needs, parsed once per request."""
 
     __slots__ = ("blocks", "matched", "kv_source", "want_role", "steered",
-                 "tenant", "priority")
+                 "tenant", "priority", "pool_match")
 
     def __init__(self):
         self.blocks: list[int] = []            # prompt prefix block hashes
@@ -86,6 +204,8 @@ class RequestCtx:
         self.steered = False                   # PD locality won the pick
         self.tenant: str = ""                  # X-Kaito-Tenant (QoS)
         self.priority: str = ""                # X-Kaito-Priority class name
+        # cluster KV pool: url -> (entry key, matched pages, entry tokens)
+        self.pool_match: dict[str, tuple] = {}
 
 
 def _extract_prompt(body: Optional[bytes]) -> str:
@@ -97,22 +217,9 @@ def _extract_prompt(body: Optional[bytes]) -> str:
         obj = json.loads(body)
     except (ValueError, UnicodeDecodeError):
         return ""
-    if not isinstance(obj, dict):
-        return ""
-    prompt = obj.get("prompt")
-    if isinstance(prompt, str):
-        return prompt
-    msgs = obj.get("messages")
-    if isinstance(msgs, list):
-        # role markers included so "same content, different role" maps
-        # to different blocks (mirrors the chat-template expansion)
-        parts = []
-        for m in msgs:
-            if isinstance(m, dict):
-                parts.append(f"<{m.get('role', '')}>"
-                             f"{m.get('content', '')}")
-        return "".join(parts)
-    return ""
+    # extraction shared with the engine-side KV-pool publisher so both
+    # hash the SAME bytes (routing.extract_prompt_text)
+    return extract_prompt_text(obj)
 
 
 def _extract_kv_source(body: Optional[bytes]) -> str:
@@ -139,7 +246,8 @@ class EndpointPicker(RoutingCore):
                  index_capacity: int = 65536,
                  plugins_config: Optional[dict] = None,
                  registry: Optional[Registry] = None,
-                 draining: Optional[Iterable[str]] = None):
+                 draining: Optional[Iterable[str]] = None,
+                 kv_pool: bool = False):
         # empty pools are legal here: a scaled-to-zero InferenceSet
         # keeps its EPP front alive so arrivals surface as
         # kaito:router_requests_received_total (the wake signal) while
@@ -153,6 +261,13 @@ class EndpointPicker(RoutingCore):
         self.plugins = [(p.get("type", ""), float(p.get("weight", 1)))
                         for p in cfg.get("plugins", [])
                         if isinstance(p, dict)]
+        # cluster KV pool (docs/kv-pool.md): the index + scorer exist
+        # only when enabled, so with the pool off the scoring math and
+        # the /metrics exposition are byte-identical to before
+        self.pool_index = KVPoolIndex() if kv_pool else None
+        if kv_pool and not any(t == "kv-pool-scorer"
+                               for t, _ in self.plugins):
+            self.plugins.append(("kv-pool-scorer", POOL_WEIGHT))
         r = self.registry
         self.m_picks = Counter(
             "kaito:epp_picks_total",
@@ -180,6 +295,19 @@ class EndpointPicker(RoutingCore):
         Gauge("kaito:epp_affinity_index_evictions",
               "Prefix block hashes evicted from the LRU index", r,
               fn=lambda: float(self.index.evictions))
+        if kv_pool:
+            self.m_pool_route = Counter(
+                "kaito:epp_kv_pool_holder_routed_total",
+                "Requests routed to a replica already holding the "
+                "matched pool prefix", r)
+            self.m_pool_fetch = Counter(
+                "kaito:epp_kv_pool_fetch_hints_total",
+                "Requests sent to a non-holder with an X-Kaito-KV-Fetch "
+                "hint (cross-replica prefix fetch)", r)
+            Gauge("kaito:epp_kv_pool_index_size",
+                  "Distinct (block_chars, block hash) rows in the "
+                  "cluster prefix->holder index", r,
+                  fn=lambda: float(len(self.pool_index)))
 
     # -- affinity block size ----------------------------------------------
     @property
@@ -220,6 +348,9 @@ class EndpointPicker(RoutingCore):
             ctx.blocks = prefix_blocks(prompt, self.block_chars)
             if ctx.blocks:
                 ctx.matched = self.index.match(ctx.blocks)
+                if self.pool_index is not None:
+                    ctx.pool_match = self.pool_index.match(
+                        ctx.blocks, self.block_chars)
         if not ctx.tenant or not ctx.priority:
             try:
                 obj = json.loads(body) if body else {}
@@ -259,6 +390,20 @@ class EndpointPicker(RoutingCore):
                         total += weight
                     elif b.group and b.group == self._source_group(ctx):
                         total += weight * 0.5
+            elif ptype == "kv-pool-scorer":
+                # cluster-pool locality: a replica holding the matched
+                # published prefix earns score proportional to how much
+                # of the prompt it covers.  Saturated or breaker-open
+                # holders earn nothing — they'd be routed to only for
+                # the KV, trading a transfer for queueing; the non-
+                # holder pick then gets a fetch hint instead
+                # (request_headers), which is the route-vs-fetch split.
+                if ctx.pool_match and not b.saturated \
+                        and b.state == "closed":
+                    info = ctx.pool_match.get(b.url)
+                    if info is not None and ctx.blocks:
+                        total += weight * min(1.0,
+                                              info[1] / len(ctx.blocks))
             elif ptype == "queue-depth-scorer":
                 total += weight / (1.0 + b.load.waiting)
             elif ptype == "kv-load-scorer":
@@ -286,6 +431,33 @@ class EndpointPicker(RoutingCore):
             # pd-filter participates as a filter, not a scorer;
             # unknown plugin types are ignored (forward compat)
         return total
+
+    def request_headers(self, ctx, backend: Backend) -> dict:
+        """Per-candidate steering (docs/kv-pool.md): when the picked
+        replica is NOT a holder of the matched pool prefix, name the
+        best live holder in ``X-Kaito-KV-Fetch`` so the replica can
+        pull the prefix over the chunked PD wire instead of
+        recomputing it.  The engine applies the final measured
+        transfer-vs-recompute veto; the EPP only nominates — so a
+        fresh scale-out replica (no measured rates yet) trusts the
+        hint, which is exactly the cold-boot case the pool serves.
+        Resolved per failover attempt: if the holder itself ends up
+        picked, no hint is sent."""
+        if not isinstance(ctx, RequestCtx) or not ctx.pool_match:
+            return {}
+        if backend.url in ctx.pool_match:
+            return {}                  # routed to a holder: no fetch
+        best_url, best = "", None
+        for b in self.backends:
+            info = ctx.pool_match.get(b.url)
+            if info is None or not b.alive or b.state != "closed":
+                continue               # dead holder: advert is stale
+            if best is None or info[1] > best[1]:
+                best_url, best = b.url, info
+        if best is None:
+            return {}
+        return {"X-Kaito-KV-Fetch": best_url,
+                "X-Kaito-KV-Fetch-Key": best[0]}
 
     def _source_group(self, ctx: RequestCtx) -> str:
         for b in self.backends:
@@ -328,6 +500,11 @@ class EndpointPicker(RoutingCore):
                     and backend.group == self._source_group(ctx))):
             ctx.steered = True         # count once per request
             self.m_pd_steered.inc()
+        if ctx.pool_match and self.pool_index is not None:
+            if backend.url in ctx.pool_match:
+                self.m_pool_route.inc()
+            elif self.request_headers(ctx, backend):
+                self.m_pool_fetch.inc()
         if ctx.blocks:
             if ctx.matched.get(backend.url, 0) > 0:
                 self.m_affinity_hits.inc()
@@ -376,6 +553,13 @@ def main(argv=None):
     ap.add_argument("--drain-timeout-s", type=float, default=30.0,
                     help="SIGTERM grace: max seconds to finish in-flight "
                          "requests before exit")
+    ap.add_argument("--kv-pool", action="store_true",
+                    help="enable the cluster KV-pool index: scrape "
+                         "/debug/kv_pool adverts, score holders, emit "
+                         "X-Kaito-KV-Fetch hints (docs/kv-pool.md)")
+    ap.add_argument("--kv-pool-scrape-interval-s", type=float, default=2.0,
+                    help="per-backend /debug/kv_pool advert scrape "
+                         "cadence (0 = off)")
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
@@ -392,10 +576,15 @@ def main(argv=None):
         block_chars=args.block_chars,
         index_capacity=args.index_capacity,
         plugins_config=plugins_config,
-        draining=args.drain_backend)
+        draining=args.drain_backend,
+        kv_pool=args.kv_pool)
     srv = make_routing_server(picker, args.host, args.port,
                               probe_interval_s=args.health_probe_interval_s,
                               scrape_interval_s=args.scrape_interval_s)
+    if args.kv_pool and args.kv_pool_scrape_interval_s > 0:
+        pool_scraper = KVPoolScraper(picker, args.kv_pool_scrape_interval_s)
+        pool_scraper.start()
+        srv.pool_scraper = pool_scraper      # type: ignore[attr-defined]
 
     def _term(signum, frame):
         logger.info("SIGTERM: draining %d in-flight request(s)",

@@ -81,6 +81,8 @@ _ENGINE_GAUGES = {
     "kaito:slots_total": ("slots_total", "sum"),
     "kaito:process_uptime_seconds": ("uptime_s", "mean"),
     "kaito:process_resident_memory_bytes": ("rss_bytes", "sum"),
+    "kaito:host_kv_entries": ("host_kv_entries", "sum"),
+    "kaito:host_kv_bytes_used": ("host_kv_bytes", "sum"),
 }
 # cumulative counters -> per-replica delta rates at fold time
 _ENGINE_COUNTERS = {
@@ -91,6 +93,9 @@ _ENGINE_COUNTERS = {
     "kaito:prefix_cache_misses_total": "prefix_misses_total",
     "kaito:spec_proposed_tokens_total": "spec_proposed_total",
     "kaito:spec_accepted_tokens_total": "spec_accepted_total",
+    "kaito:host_kv_hits_total": "host_kv_hits_total",
+    "kaito:host_kv_misses_total": "host_kv_misses_total",
+    "kaito:host_kv_evictions_total": "host_kv_evictions_total",
 }
 # EPP / router front series (arrival side of the same CR).  The
 # received counter keeps ticking even with ZERO backends — it is the
@@ -618,6 +623,8 @@ class FleetTelemetry:
         keys = ["requests_total", "shed_total", "gen_tokens_total",
                 "prefix_hits_total", "prefix_misses_total",
                 "spec_proposed_total", "spec_accepted_total",
+                "host_kv_hits_total", "host_kv_misses_total",
+                "host_kv_evictions_total",
                 "forwarded_total", "received_total"]
         # per-tenant counters carry the tenant in the key itself
         # ("tenant_shed_total:acme"), so rate whatever both samples have
@@ -745,6 +752,8 @@ class FleetTelemetry:
         miss = rate("prefix_misses_rate")
         prop = rate("spec_proposed_rate")
         acc = rate("spec_accepted_rate")
+        hkv_hit = rate("host_kv_hits_rate")
+        hkv_miss = rate("host_kv_misses_rate")
         agg = {
             "replicas_reporting": float(len(replicas)),
             "queue_sum": fold("waiting", "sum"),
@@ -765,6 +774,15 @@ class FleetTelemetry:
             "burn_max": max(vals("burn_max"), default=0.0),
             "prefix_hit_rate": hit / (hit + miss) if hit + miss > 0 else 0.0,
             "spec_accept_rate": acc / prop if prop > 0 else 0.0,
+            # host KV offload tier, cluster-wide: capacity (entries /
+            # bytes sums), churn (evictions/s), and effectiveness (hit
+            # fraction of pops) — the rollout dashboards judge whether
+            # the tier is sized right from these three
+            "host_kv_entries": fold("host_kv_entries", "sum"),
+            "host_kv_bytes": fold("host_kv_bytes", "sum"),
+            "host_kv_evictions_rate": rate("host_kv_evictions_rate"),
+            "host_kv_hit_rate": (hkv_hit / (hkv_hit + hkv_miss)
+                                 if hkv_hit + hkv_miss > 0 else 0.0),
         }
         if epps:
             agg["arrival_rate"] = sum(
@@ -963,6 +981,19 @@ class FleetTelemetry:
         Gauge("kaito:fleet_slo_burn_max",
               "Worst replica fast-window SLO burn per CR", r,
               labels=("kind", "name"), fn=family("burn_max"))
+        Gauge("kaito:fleet_host_kv_entries",
+              "Host KV offload entries summed over the fleet", r,
+              labels=("kind", "name"), fn=family("host_kv_entries"))
+        Gauge("kaito:fleet_host_kv_bytes",
+              "Host KV offload bytes summed over the fleet", r,
+              labels=("kind", "name"), fn=family("host_kv_bytes"))
+        Gauge("kaito:fleet_host_kv_evictions_per_s",
+              "Fleet host KV offload eviction rate (churn)", r,
+              labels=("kind", "name"),
+              fn=family("host_kv_evictions_rate"))
+        Gauge("kaito:fleet_host_kv_hit_rate",
+              "Fleet host KV offload hit ratio (rate-weighted)", r,
+              labels=("kind", "name"), fn=family("host_kv_hit_rate"))
 
         def tenant_family(prefix):
             def _fn():

@@ -223,6 +223,29 @@ def prefix_blocks(text: str, block_chars: int) -> list[int]:
     return out
 
 
+def extract_prompt_text(obj) -> str:
+    """The prompt string the routing layer hashes, from a PARSED
+    request body.  Shared by the EPP and the engine's KV-pool
+    publisher: both sides must hash the SAME bytes or the cluster
+    prefix index silently never matches (tests/test_kv_pool.py)."""
+    if not isinstance(obj, dict):
+        return ""
+    prompt = obj.get("prompt")
+    if isinstance(prompt, str):
+        return prompt
+    msgs = obj.get("messages")
+    if isinstance(msgs, list):
+        # role markers included so "same content, different role" maps
+        # to different blocks (mirrors the chat-template expansion)
+        parts = []
+        for m in msgs:
+            if isinstance(m, dict):
+                parts.append(f"<{m.get('role', '')}>"
+                             f"{m.get('content', '')}")
+        return "".join(parts)
+    return ""
+
+
 class PrefixAffinityIndex:
     """Bounded LRU of recent prompt-prefix block hashes per backend.
 
@@ -604,6 +627,13 @@ class RoutingCore:
         (round-robin) front needs nothing."""
         return None
 
+    def request_headers(self, ctx, backend: "Backend") -> dict:
+        """Extra headers to inject into the forwarded request, resolved
+        per CANDIDATE backend (the EPP's KV-pool front steers a picked
+        replica to fetch a prefix from its holder via
+        ``X-Kaito-KV-Fetch``).  The base front injects nothing."""
+        return {}
+
     def candidates(self, method: str, path: str, ctx) -> Iterable[Backend]:
         """One preference-ordered pass over the replicas for one retry
         cycle.  The default is the classic round robin."""
@@ -772,7 +802,7 @@ def make_routing_server(core: RoutingCore, host: str = "0.0.0.0",
                         core.m_retries.inc(backend=b.url)
                     t_fwd = time.monotonic()
                     try:
-                        resp, conn = self._connect(b, method, body)
+                        resp, conn = self._connect(b, method, body, ctx)
                     except (ConnectionError, OSError, FailpointError) as e:
                         logger.warning("backend %s unreachable (%s); "
                                        "skipping", b.url, e)
@@ -816,7 +846,7 @@ def make_routing_server(core: RoutingCore, host: str = "0.0.0.0",
                             headers={"Retry-After": 1})
 
         def _connect(self, b: Backend, method: str,
-                     body: Optional[bytes]):
+                     body: Optional[bytes], ctx=None):
             """Send the request and read the response HEAD; raises are
             retryable (nothing has reached the client yet)."""
             FAILPOINTS.fire("router.forward", backend=b.url)
@@ -826,6 +856,10 @@ def make_routing_server(core: RoutingCore, host: str = "0.0.0.0",
                        and k.lower() not in ("content-length",
                                              "x-request-id")}
             headers["X-Request-Id"] = self._rid
+            # per-candidate steering headers from the front (e.g. the
+            # EPP's KV-pool fetch hint) — resolved HERE because the
+            # chosen backend differs per failover attempt
+            headers.update(core.request_headers(ctx, b) or {})
             conn.request(method, self.path, body=body, headers=headers)
             return conn.getresponse(), conn
 
