@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: all native unit-test unit-test-fast unit-test-slow engine-test rag-test chaos kvq kvpool obs slo fleet autoscale spec qos bench serve manager epp clean
+.PHONY: all native unit-test unit-test-fast unit-test-slow engine-test rag-test chaos kvq wquant kvpool obs slo fleet autoscale spec qos bench serve manager epp clean
 
 all: native
 
@@ -40,6 +40,15 @@ chaos:
 kvq:
 	$(PYTHON) -m pytest tests/test_kv_quant.py -q
 	$(PYTHON) -m pytest tests/test_real_checkpoint.py -q -k "kv_int8"
+
+# weight-quant suite (docs/quantization.md): int4 pack/unpack, fused
+# kernel parity (interpreter mode), quantize-at-load invariants,
+# annotation plumbing, compose leg, golden-pinned int8/int4 serving on
+# the committed real checkpoints
+wquant:
+	$(PYTHON) -m pytest tests/test_weight_quant.py -q
+	$(PYTHON) -m pytest tests/test_real_checkpoint.py -q \
+	  -k "weight_int4 or int8"
 
 # cluster KV pool suite (docs/kv-pool.md): hash parity, store LRU +
 # export TTL GC, EPP index/scoring/headers, publish→fetch→import

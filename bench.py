@@ -277,6 +277,43 @@ def orchestrate(args):
                 res.get("error", "kv-int8 raw failed"))
         save_partial()
 
+    # --- phase: bf16-vs-int8-vs-int4 WEIGHT ladder (same batch/shape
+    # knobs, only the weight bytes change; docs/quantization.md).
+    # Decode is param-bandwidth-bound, so each halving of the weight
+    # stream should move tok/s — weight_quant_speedup_* is that claim
+    # measured against the bf16 headline above.  Quality rides in the
+    # separate wquant_quality phase (golden-prompt divergence). ---
+    if not args.skip_wquant and not args.quant:
+        for scheme in ("int8", "int4"):
+            if remaining() <= 60:
+                break
+            res = run_phase("raw", passthru + ["--quant", scheme],
+                            min(remaining(), 700.0))
+            if "value" in res and res.get("value", 0) > 0:
+                merged[f"weight_{scheme}_decode_tok_s"] = res["value"]
+                merged[f"weight_{scheme}_metric"] = res.get("metric", "")
+                for k in ("mfu_pct", "hbm_roofline_pct", "batch",
+                          "ttft_p50_ms"):
+                    if k in res:
+                        merged[f"weight_{scheme}_{k}"] = res[k]
+                if merged.get("value", 0) > 0:
+                    merged[f"weight_quant_speedup_{scheme}"] = round(
+                        res["value"] / merged["value"], 3)
+            else:
+                merged.setdefault("errors", []).append(
+                    res.get("error", f"weight-{scheme} raw failed"))
+            save_partial()
+
+    # --- phase: weight-quant quality legs (CPU-cheap: greedy goldens
+    # on a real checkpoint per scheme, count divergent prompts) ---
+    if not args.skip_wquant and remaining() > 90:
+        res = run_phase("wquant_quality", [], min(remaining(), 500.0))
+        if "error" not in res:
+            merged.update(res)
+        else:
+            merged.setdefault("errors", []).append(res["error"])
+        save_partial()
+
     # --- phase: serving path (engine under load) ---
     if not args.skip_server_bench and remaining() > 120:
         res = run_phase("serve", passthru, min(remaining(), 650.0))
@@ -736,9 +773,16 @@ def _roofline_metrics(arch, tok_s, batch, ctx, *, quant="", kv_dtype="",
 
     chip = CHIP_CATALOG[chip_name]
     n_params = arch.param_count()
+    # int4 dequantizes to bf16/fp32 in-register before the MXU dot, so
+    # its compute peak is the bf16 one; only int8 (native int8 dots)
+    # earns the int8_tops peak
     peak_flops = (chip.int8_tops if quant == "int8"
                   else chip.bf16_tflops) * 1e12
-    param_bytes = n_params * (1 if quant == "int8" else 2)
+    # bytes/param streamed each decode step: bf16 2, int8 1 (+fp32
+    # per-out-channel scale, negligible), int4 0.5 + fp32 per-group
+    # scales at g=128 -> 0.5 + 4/128 = 0.53125
+    param_bytes = n_params * {"": 2.0, "int8": 1.0,
+                              "int4": 0.53125}.get(quant, 2.0)
     kv_elt = 1 if kv_dtype == "int8" else 2
     kv_bpt = (2.0 * arch.num_layers * arch.num_kv_heads
               * arch.head_dim * kv_elt)
@@ -775,9 +819,11 @@ def phase_raw(args):
         batch_ladder = [args.batch]
     elif not on_tpu:
         batch_ladder = [4]
-    elif args.quant == "int8":
-        # int8 halves weight bytes -> deeper batches fit (measured:
-        # 112 -> 6.7k, 160 -> 7.3k, 224 -> 7.8k tok/s)
+    elif args.quant:
+        # int8 halves (int4 ~quarters) weight bytes -> deeper batches
+        # fit (int8 measured: 112 -> 6.7k, 160 -> 7.3k, 224 -> 7.8k
+        # tok/s); int4 reuses the same ladder — KV, not weights, caps
+        # batch there
         batch_ladder = [224, 160, 112, 64]
     else:
         batch_ladder = [112, 96, 64]
@@ -799,12 +845,15 @@ def phase_raw(args):
     jax.block_until_ready(params)
     log(f"params ready in {time.monotonic() - t0:.1f}s "
         f"({sum(x.nbytes for x in jax.tree.leaves(params)) / 2**30:.2f} GiB)")
-    if args.quant == "int8":
+    if args.quant:
+        from functools import partial
+
         from kaito_tpu.engine.quant import quantize_params
 
-        params = jax.jit(quantize_params)(params)
+        params = jax.jit(partial(quantize_params,
+                                 scheme=args.quant))(params)
         jax.block_until_ready(params)
-        log(f"int8 weights: "
+        log(f"{args.quant} weights: "
             f"{sum(x.nbytes for x in jax.tree.leaves(params)) / 2**30:.2f} GiB")
 
     page_size = 64
@@ -945,7 +994,7 @@ def phase_raw(args):
         log(f"ttft measurement failed ({type(e).__name__}: {e}); omitting")
         ttft_ms = None
 
-    suffix = "_int8" if args.quant == "int8" else ""
+    suffix = f"_{args.quant}" if args.quant else ""
     if args.kv_dtype == "int8":
         suffix += "_kvint8"
     result = {
@@ -982,6 +1031,66 @@ def phase_serve(args):
                              spec_draft=spec_draft,
                              spec_temp=args.spec_temp)
     print(json.dumps(res), flush=True)
+
+
+def phase_wquant_quality(args):
+    """Weight-quant quality legs: serve the committed REAL checkpoints
+    under each weight scheme and count golden prompts whose greedy
+    continuation diverges from the pinned fp32 golden.  This is the
+    quality half of the weight ladder — the throughput rows say int4 is
+    faster, this row says what it costs (tests/test_weight_quant.py
+    pins the same continuations exactly; here we just report counts).
+    CPU-cheap: the checkpoints are ~5M-param byte LMs."""
+    _init_jax(force_cpu=args.force_cpu)
+    import glob as _glob
+
+    from kaito_tpu.engine.config import EngineConfig
+    from kaito_tpu.engine.engine import InferenceEngine, SamplingParams
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    testdata = os.path.join(repo, "tests", "testdata")
+    models = sorted(
+        os.path.basename(os.path.dirname(p))
+        for p in _glob.glob(os.path.join(repo, "checkpoints", "*",
+                                         "model.safetensors"))
+        if os.path.exists(os.path.join(
+            testdata,
+            f"goldens_{os.path.basename(os.path.dirname(p))}.json")))
+    if not models:
+        print(json.dumps({"error": "no committed checkpoints"}), flush=True)
+        return
+
+    out = {"wquant_models": ",".join(models)}
+    totals = {"int8": 0, "int4": 0}
+    n_prompts = 0
+    for model in models:
+        golden = json.load(open(os.path.join(testdata,
+                                             f"goldens_{model}.json")))
+        n_prompts += len(golden["prompts"])
+        for scheme in ("int8", "int4"):
+            cfg = EngineConfig(
+                model=model,
+                weights_dir=os.path.join(repo, "checkpoints", model),
+                dtype="float32", max_model_len=512, max_num_seqs=2,
+                prefill_buckets=(64, 128), enable_prefix_caching=False,
+                quantization=scheme, seed=0)
+            eng = InferenceEngine(cfg)
+            eng.start()
+            try:
+                for p in golden["prompts"]:
+                    want = p["fp32"]["greedy_tokens"]
+                    req = eng.submit(
+                        list(p["prompt_tokens"]),
+                        SamplingParams(max_tokens=len(want),
+                                       temperature=0.0, ignore_eos=True))
+                    if list(req.stream()) != want:
+                        totals[scheme] += 1
+            finally:
+                eng.stop()
+    out["wquant_prompts_total"] = n_prompts
+    out["weight_int8_divergent_prompts"] = totals["int8"]
+    out["weight_int4_divergent_prompts"] = totals["int4"]
+    print(json.dumps(out), flush=True)
 
 
 def phase_prefix(args):
@@ -1315,7 +1424,8 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--phase", default="",
                     choices=["", "watch", "probe", "raw", "serve",
-                             "int8_8b", "pd", "cp", "prefix", "kvpool"])
+                             "int8_8b", "pd", "cp", "prefix", "kvpool",
+                             "wquant_quality"])
     ap.add_argument("--cp-tokens", type=int, default=8192)
     ap.add_argument("--cp-attn-only", action="store_true",
                     help="cp phase: measure only the per-chip shard-"
@@ -1340,13 +1450,16 @@ def main():
     ap.add_argument("--decode-steps", type=int, default=128)
     ap.add_argument("--repeats", type=int, default=3)
     ap.add_argument("--attn-impl", default="", choices=["", "jax", "pallas"])
-    ap.add_argument("--quant", default="", choices=["", "int8"])
+    ap.add_argument("--quant", default="", choices=["", "int8", "int4"])
     ap.add_argument("--kv-dtype", default="",
                     choices=["", "bfloat16", "int8"],
                     help="KV page-pool dtype for the raw decode ladder "
                          "(int8 = quantized pages + fp32 page scales)")
     ap.add_argument("--skip-kv-int8", action="store_true",
                     help="skip the int8-KV decode comparison row")
+    ap.add_argument("--skip-wquant", action="store_true",
+                    help="skip the bf16-vs-int8-vs-int4 weight ladder "
+                         "and its quality legs")
     ap.add_argument("--force-cpu", action="store_true")
     ap.add_argument("--skip-server-bench", action="store_true")
     ap.add_argument("--skip-int8-8b", action="store_true")
@@ -1360,6 +1473,8 @@ def main():
         phase_probe()
     elif args.phase == "prefix":
         phase_prefix(args)
+    elif args.phase == "wquant_quality":
+        phase_wquant_quality(args)
     elif args.phase == "raw":
         phase_raw(args)
     elif args.phase == "serve":

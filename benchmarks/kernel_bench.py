@@ -135,6 +135,53 @@ def bench_decode_int8(iters: int) -> None:
               f"live-KV read {nbytes / dt / 1e9:6.1f} GB/s")
 
 
+def bench_gemv_quant(iters: int, scheme: str) -> None:
+    """Quantized-weight decode GEMV row (the fused dequant matmul).
+
+    Runs the fused Pallas kernel (interpreter mode on CPU, so the row
+    stays runnable anywhere) against the pure-JAX dequant fallback for
+    the same QTensor.  The bytes column counts what decode actually
+    streams per call: the quantized slab plus scale rows — int8 moves
+    K*N bytes, int4 moves K*N/2 + per-group scales, which is why the
+    weight ladder keeps paying off (docs/quantization.md).  On CPU the
+    parity line is the point; GB/s is only meaningful on a real chip."""
+    from kaito_tpu.engine.ops.quant_matmul import (dequant_matmul_jax,
+                                                   quant_matmul)
+    from kaito_tpu.engine.quant import quantize_weight
+
+    on_cpu = jax.default_backend() == "cpu"
+    if on_cpu:
+        rows, K, N = 4, 1024, 1024
+    else:
+        rows, K, N = 8, 4096, 4096
+    dt = jnp.float32 if on_cpu else jnp.bfloat16
+    key = jax.random.PRNGKey(3)
+    kx, kw = jax.random.split(key)
+    x = jax.random.normal(kx, (rows, K), dt)
+    w = jax.random.normal(kw, (K, N), jnp.float32)
+    qw = jax.jit(lambda w: quantize_weight(w, scheme))(w)
+
+    o_p = quant_matmul(x, qw, interpret=on_cpu)
+    o_j = dequant_matmul_jax(x, qw)
+    err = float(jnp.max(jnp.abs(o_p.astype(jnp.float32)
+                                - o_j.astype(jnp.float32))))
+    denom = float(jnp.max(jnp.abs(o_j))) or 1.0
+    print(f"gemv[{scheme}] rows={rows} K={K} N={N} "
+          f"pallas-vs-jax: max rel err = {err / denom:.2e}")
+
+    f_pallas = jax.jit(lambda x, qw: quant_matmul(x, qw, interpret=on_cpu))
+    f_jax = jax.jit(dequant_matmul_jax)
+    if scheme == "int4":
+        g_groups = qw["scale"].shape[-2]
+        w_bytes = K * N / 2 + 4 * g_groups * N
+    else:
+        w_bytes = K * N + 4 * N
+    for name, fn in (("pallas", f_pallas), ("jax", f_jax)):
+        dt_s = _timeit(fn, x, qw, iters=iters)
+        print(f"gemv[{scheme}-{name}]: {dt_s * 1e6:8.1f} us/call, "
+              f"weight read {w_bytes / dt_s / 1e9:6.1f} GB/s")
+
+
 def bench_prefill(iters: int) -> None:
     from kaito_tpu.engine.attention import prefill_attention
     from kaito_tpu.engine.ops.flash_prefill import flash_prefill_attention
@@ -171,15 +218,22 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--decode", action="store_true")
     ap.add_argument("--decode-int8", action="store_true")
+    ap.add_argument("--gemv-int8", action="store_true")
+    ap.add_argument("--gemv-int4", action="store_true")
     ap.add_argument("--prefill", action="store_true")
     ap.add_argument("--iters", type=int, default=50)
     args = ap.parse_args()
-    run_all = not (args.decode or args.prefill or args.decode_int8)
+    run_all = not (args.decode or args.prefill or args.decode_int8
+                   or args.gemv_int8 or args.gemv_int4)
     print(f"backend: {jax.default_backend()}, device: {jax.devices()[0]}")
     if args.decode or run_all:
         bench_decode(args.iters)
     if args.decode_int8 or run_all:
         bench_decode_int8(args.iters)
+    if args.gemv_int8 or run_all:
+        bench_gemv_quant(args.iters, "int8")
+    if args.gemv_int4 or run_all:
+        bench_gemv_quant(args.iters, "int4")
     if args.prefill or run_all:
         bench_prefill(args.iters)
 

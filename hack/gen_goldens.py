@@ -29,9 +29,25 @@ PROMPTS = [
 ]
 
 
+# pinned modes: full-precision reference, int8 WEIGHTS (quantization),
+# int8 KV CACHE (kv_dtype), int4 WEIGHTS — each drifts for a different
+# reason, so each pins to its own golden.  ("int8" is the weight-int8
+# section; the name predates the weight ladder.)
+MODES = (("fp32", "", "float32"),
+         ("int8", "int8", "float32"),
+         ("kv_int8", "", "int8"),
+         ("weight_int4", "int4", "float32"))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="tiny-llama-real")
+    ap.add_argument("--modes", default="",
+                    help="comma list of mode keys to (re)generate "
+                         "(default: only modes MISSING from the "
+                         "existing golden file — pinned sections never "
+                         "drift by accident); 'all' regenerates "
+                         "everything")
     args = ap.parse_args()
     ckpt = os.path.join(REPO, "checkpoints", args.model)
     out_path = os.path.join(REPO, "tests", "testdata",
@@ -44,12 +60,21 @@ def main():
               "report": json.load(open(os.path.join(
                   ckpt, "training_report.json"))),
               "prompts": []}
-    # three pinned modes: full-precision reference, int8 WEIGHTS
-    # (quantization), int8 KV CACHE (kv_dtype) — each drifts for a
-    # different reason, so each pins to its own golden
-    for key, quant, kv_dtype in (("fp32", "", "float32"),
-                                 ("int8", "int8", "float32"),
-                                 ("kv_int8", "", "int8")):
+    if os.path.exists(out_path):
+        golden["prompts"] = json.load(open(out_path))["prompts"]
+    have = set().union(*(set(p) - {"text", "prompt_tokens"}
+                         for p in golden["prompts"])) \
+        if golden["prompts"] else set()
+    if args.modes == "all":
+        wanted = [m for m in MODES]
+    elif args.modes:
+        wanted = [m for m in MODES if m[0] in args.modes.split(",")]
+    else:
+        wanted = [m for m in MODES if m[0] not in have]
+    if not wanted:
+        print(f"{out_path}: all modes present; use --modes to regen")
+        return
+    for key, quant, kv_dtype in wanted:
         cfg = EngineConfig(model=args.model, weights_dir=ckpt,
                            dtype="float32", kv_dtype=kv_dtype,
                            max_model_len=512, max_num_seqs=2,

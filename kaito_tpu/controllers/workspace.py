@@ -94,6 +94,18 @@ def plan_workspace(store: Store, ws: Workspace):
     # through kv_bytes_per_token)
     kv_dtype = ws.metadata.annotations.get(
         "kaito-tpu.io/kv-cache-dtype", "")
+    # weight-only quantization shrinks weight bytes (int8: 1/2, int4:
+    # ~1/4 with group scales), so the same model fits fewer chips; a
+    # bogus scheme fails the plan (PlanFailed condition + event) before
+    # any capacity is asked for, mirroring the qos/speculative-draft
+    # pattern (docs/quantization.md)
+    quant = ws.metadata.annotations.get("kaito-tpu.io/quantization", "")
+    if quant and quant not in ("int8", "int4"):
+        # mirrors engine/quant.py QUANT_SCHEMES without importing the
+        # engine (the controller stays jax-free, like the qos check)
+        raise ValueError(
+            f"invalid kaito-tpu.io/quantization annotation: unknown "
+            f"scheme {quant!r} (known: int8, int4)")
     # speculative-draft pairing fails the plan (PlanFailed
     # condition + event) when the named draft is unknown or shares
     # no tokenizer with the target — before any capacity is asked
@@ -118,6 +130,7 @@ def plan_workspace(store: Store, ws: Workspace):
     plan = plan_parallelism(md, chip, workload=workload,
                             target_chips=target,
                             kv_dtype_bytes=1 if kv_dtype == "int8" else 2,
+                            quantization=quant or None,
                             cp_autocarve=cp_opt_in)
     slice_spec = TPUSliceSpec(
         chip=chip, topology=plan.topology,

@@ -30,9 +30,12 @@ class EngineConfig:
     # ~2x pages at equal HBM and half the decode-step KV read.
     kv_dtype: str = "bfloat16"
     # weight-only quantization: "" (off) | "int8" (per-out-channel
-    # symmetric; dense GQA families).  Decode is param-bandwidth-bound,
-    # so int8 weights are a direct throughput lever; the reference's
-    # vLLM surface exposes the same knob as --quantization.
+    # symmetric) | "int4" (packed two-per-byte, per-group g=128
+    # per-out-channel scales; fused Pallas dequant matmul on TPU —
+    # docs/quantization.md).  Decode is param-bandwidth-bound, so
+    # halving/quartering weight bytes is a direct throughput lever;
+    # the reference's vLLM surface exposes the same knob as
+    # --quantization.
     quantization: str = ""
     seed: int = 0
     tensor_parallel: int = 1             # TP degree (mesh "tensor" axis)

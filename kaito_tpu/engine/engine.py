@@ -294,9 +294,19 @@ class InferenceEngine:
         self.buckets = tuple(sorted(
             {b for b in cfg.prefill_buckets if b < cfg.max_model_len}
             | {cfg.max_model_len}))
-        if cfg.quantization and cfg.quantization != "int8":
+        if cfg.quantization:
+            from kaito_tpu.engine.quant import (QUANT_SCHEMES,
+                                                supports_quantization)
+
             # fail fast BEFORE any allocation or weight loading
-            raise ValueError(f"unknown quantization {cfg.quantization!r}")
+            if cfg.quantization not in QUANT_SCHEMES:
+                raise ValueError(
+                    f"unknown quantization {cfg.quantization!r} "
+                    f"(known: {', '.join(QUANT_SCHEMES)})")
+            if not supports_quantization(arch, cfg.quantization):
+                raise ValueError(
+                    f"quantization {cfg.quantization!r} does not support "
+                    f"this architecture (hidden_size={arch.hidden_size})")
 
         # params BEFORE the KV pool: sizing reads the ACTUAL resident
         # weight bytes (post-quantization), and quantizing with a
@@ -319,18 +329,19 @@ class InferenceEngine:
 
                 t0 = time.monotonic()
                 # under a TP mesh the QTensor tree gets explicit
-                # shardings derived from SERVE_RULES (q8 keeps the
-                # weight's spec, the per-out-channel scale keeps the
-                # out dim's); otherwise XLA would be free to re-lay-out
-                # the donated tree
+                # shardings derived from SERVE_RULES (q8/q4 keep the
+                # weight's spec, the scale keeps the out dim's — plus
+                # the group dim's under int4); otherwise XLA would be
+                # free to re-lay-out the donated tree
                 qkw = ({"out_shardings": self._quantized_param_shardings()}
                        if self.mesh is not None else {})
                 self.params = jax.jit(
-                    quantize_params, donate_argnums=0, **qkw)(self.params)
+                    partial(quantize_params, scheme=cfg.quantization),
+                    donate_argnums=0, **qkw)(self.params)
                 jax.block_until_ready(self.params)
                 logger.info(
-                    "int8 weights ready in %.1fs (%.2f GiB)",
-                    time.monotonic() - t0,
+                    "%s weights ready in %.1fs (%.2f GiB)",
+                    cfg.quantization, time.monotonic() - t0,
                     sum(x.nbytes for x in jax.tree.leaves(self.params))
                     / 2**30)
 
@@ -657,14 +668,20 @@ class InferenceEngine:
             axes, is_leaf=lambda x: isinstance(x, tuple))
 
     def _quantized_param_shardings(self):
-        """Shardings for the post-quantization tree: q8 keeps its
-        weight's SERVE_RULES spec; the per-out-channel scale drops the
-        contracted (in) dim and keeps the out dim's assignment."""
+        """Shardings for the post-quantization tree: q8/q4 keep their
+        weight's SERVE_RULES spec (int4's packed dim is still the in
+        axis, at half length, and adjacent-pair packing keeps shard
+        boundaries aligned with original rows); the scale drops the
+        contracted (in) dim, except int4's group dim which inherits the
+        in axis's assignment so scale rows follow their groups'
+        shards."""
         from jax.sharding import NamedSharding
 
         from kaito_tpu.engine.quant import is_quantized_leaf, \
             qtensor_logical_axes
         from kaito_tpu.parallel.sharding import SERVE_RULES
+
+        scheme = self.cfg.quantization or "int8"
 
         def sh(ax):
             return NamedSharding(self.mesh, SERVE_RULES.spec(ax))
@@ -673,7 +690,7 @@ class InferenceEngine:
         for k, v in self.model.param_logical_axes().items():
             if isinstance(v, dict):
                 out[k] = {
-                    n: (jax.tree.map(sh, qtensor_logical_axes(ax),
+                    n: (jax.tree.map(sh, qtensor_logical_axes(ax, scheme),
                                      is_leaf=lambda x: isinstance(x, tuple))
                         if is_quantized_leaf(k, n) else sh(ax))
                     for n, ax in v.items()}
@@ -741,7 +758,9 @@ class InferenceEngine:
                     kw = ({"out_shardings": dict(out_sh)}
                           if out_sh is not None else {})
                     fn = qfns[out_sh] = jax.jit(
-                        quantize_weight, donate_argnums=0, **kw)
+                        partial(quantize_weight,
+                                scheme=self.cfg.quantization),
+                        donate_argnums=0, **kw)
                 arr = fn(arr)
             return arr
 
@@ -751,7 +770,8 @@ class InferenceEngine:
         if self.cfg.weights_dir:
             wd = self.cfg.weights_dir
             logger.info("loading checkpoint from %s%s", wd,
-                        " (int8 per-tensor quantize-on-load)"
+                        f" ({self.cfg.quantization} per-tensor "
+                        "quantize-on-load)"
                         if self.cfg.quantization else "")
             transform = self._make_leaf_transform()
             if wd.startswith(("gs://", "http://", "https://")):
@@ -788,15 +808,16 @@ class InferenceEngine:
 
     def _init_quantized_params(self):
         """Synthetic weights, quantized inside the init jit (see
-        __init__: keeps peak HBM at int8-tree + one bf16 leaf)."""
+        __init__: keeps peak HBM at quantized-tree + one bf16 leaf)."""
         from kaito_tpu.engine.quant import quantize_params
 
-        logger.info("initializing synthetic int8 weights for %s (mesh=%s)",
-                    self.md.name, self.mesh)
+        logger.info("initializing synthetic %s weights for %s (mesh=%s)",
+                    self.cfg.quantization, self.md.name, self.mesh)
         t0 = time.monotonic()
 
         def init_q(key):
-            return quantize_params(self.model.init_params(key))
+            return quantize_params(self.model.init_params(key),
+                                   scheme=self.cfg.quantization)
 
         if self.mesh is not None:
             params = jax.jit(
@@ -807,8 +828,8 @@ class InferenceEngine:
             with jax.default_device(jax.local_devices()[0]):
                 params = jax.jit(init_q)(jax.random.PRNGKey(self.cfg.seed))
         jax.block_until_ready(params)
-        logger.info("int8 weights ready in %.1fs (%.2f GiB)",
-                    time.monotonic() - t0,
+        logger.info("%s weights ready in %.1fs (%.2f GiB)",
+                    self.cfg.quantization, time.monotonic() - t0,
                     sum(x.nbytes for x in jax.tree.leaves(params)) / 2**30)
         return params
 

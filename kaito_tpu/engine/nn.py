@@ -19,15 +19,20 @@ from kaito_tpu.models.metadata import ModelArch
 
 
 def linear(x: jax.Array, w) -> jax.Array:
-    """Matmul accepting either a plain weight or an int8 QTensor dict
-    ``{"q8": int8[in,out], "scale": f32[out]}`` (per-out-channel
-    symmetric quantization).  Under jit the int8 stays in HBM and the
-    dequant fuses into the dot — the QLoRA memory model.
+    """Matmul accepting either a plain weight or a QTensor dict —
+    int8 ``{"q8": int8[in,out], "scale": f32[out]}`` or packed int4
+    ``{"q4": int8[in/2,out], "scale": f32[G,out]}`` (engine/quant.py).
+    QTensors route through ops/quant_matmul.quant_linear: the fused
+    Pallas dequant kernel for decode-shaped calls on TPU (the HBM read
+    is the quantized bytes by construction), pure-JAX dequant-into-dot
+    everywhere else — the QLoRA memory model either way.
     """
     from kaito_tpu.engine.quant import is_qtensor
 
     if is_qtensor(w):
-        return (x @ w["q8"].astype(x.dtype)) * w["scale"].astype(x.dtype)
+        from kaito_tpu.engine.ops.quant_matmul import quant_linear
+
+        return quant_linear(x, w)
     return x @ w
 
 
@@ -312,12 +317,18 @@ def moe_mlp(x: jax.Array, p: dict, arch: ModelArch) -> jax.Array:
     route = route.at[jnp.arange(T)[:, None], idx].set(weights)
     # dense expert compute: h[x] = act(x @ gate_x) * (x @ up_x) @ down_x
     def expert_dot(spec, lhs, w):
-        """einsum accepting a plain [X, in, out] stack or an int8
-        QTensor {"q8", "scale": [X, out]} (dequant fuses into the dot;
-        the per-expert scale rides the output's [x, out] dims)."""
-        from kaito_tpu.engine.quant import is_qtensor
+        """einsum accepting a plain [X, in, out] stack or a QTensor:
+        int8 {"q8", "scale": [X, out]} keeps the fused form (dequant
+        fuses into the dot; the per-expert scale rides the output's
+        [x, out] dims); int4's per-GROUP scales can't fold post-dot
+        across groups, so the expert stack dequants to lhs.dtype first
+        (elementwise — XLA fuses it into the einsum's RHS read)."""
+        from kaito_tpu.engine.quant import (dequant_weight, is_qtensor,
+                                            qtensor_kind)
 
         if is_qtensor(w):
+            if qtensor_kind(w) == "int4":
+                return jnp.einsum(spec, lhs, dequant_weight(w, lhs.dtype))
             return jnp.einsum(spec, lhs, w["q8"].astype(lhs.dtype)) \
                 * w["scale"].astype(lhs.dtype)
         return jnp.einsum(spec, lhs, w)
@@ -357,12 +368,19 @@ def moe_mlp_ragged(x: jax.Array, p: dict, arch: ModelArch) -> jax.Array:
     expert_of_row = flat_expert[order]                 # [T*k]
 
     def ragged(lhs, w):
-        """ragged_dot accepting a plain stack or an int8 QTensor: the
-        convert fuses into the grouped GEMM's RHS load, and each row's
-        output scales by its expert's per-out-channel scale."""
-        from kaito_tpu.engine.quant import is_qtensor
+        """ragged_dot accepting a plain stack or a QTensor: int8's
+        convert fuses into the grouped GEMM's RHS load and each row's
+        output scales by its expert's per-out-channel scale; int4
+        dequants the stack first (per-group scales don't fold post-dot
+        across groups — same trade as expert_dot in moe_mlp)."""
+        from kaito_tpu.engine.quant import (dequant_weight, is_qtensor,
+                                            qtensor_kind)
 
         if is_qtensor(w):
+            if qtensor_kind(w) == "int4":
+                return jax.lax.ragged_dot(
+                    lhs, dequant_weight(w, lhs.dtype), group_sizes,
+                    preferred_element_type=jnp.float32)
             out = jax.lax.ragged_dot(lhs, w["q8"].astype(lhs.dtype),
                                      group_sizes,
                                      preferred_element_type=jnp.float32)

@@ -1,0 +1,237 @@
+"""Pallas TPU kernel: fused dequant matmul for quantized weights.
+
+The decode-step GEMV/skinny-GEMM against an int8 or packed-int4
+QTensor (engine/quant.py), with the same dequant-after-DMA discipline
+as the int8 KV decode kernel (ops/decode_attention.py): the grid
+pipelines the QUANTIZED weight blocks and their scale rows into VMEM
+(pallas double-buffers each input stream on its own ring), the kernel
+unpacks/dequants in-register, and partial products accumulate in an
+fp32 VMEM scratch — so the HBM stream is the quantized bytes by
+construction, never a materialized bf16 copy of the weight.
+
+Layout contract (engine/quant.py): int4 packs ADJACENT in-row pairs
+(row 2i low nibble, row 2i+1 high nibble) and every weight chunk the
+kernel sees spans exactly one scale group, so the per-group scale
+folds POST-dot:
+
+    acc += (x_even_chunk @ lo_nibbles + x_odd_chunk @ hi_nibbles) * s_g
+
+The even/odd x columns are two cheap strided slices of the (tiny)
+activation taken once outside the kernel — no in-kernel interleave or
+transpose, which Mosaic would serialize.
+
+``quant_linear`` is the nn.linear entry point: it picks the kernel for
+decode-shaped calls (rows <= MAX_ROWS, tileable shapes) on TPU and the
+pure-JAX unpack-then-dot fallback everywhere else (CPU tests, prefill,
+odd shapes).  KAITO_QUANT_MATMUL=auto|pallas|interpret|jax overrides
+the choice (read at trace time; 'interpret' runs the kernel in
+interpreter mode so CPU tests cover the kernel path end-to-end).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from kaito_tpu.engine.quant import dequant_weight, int4_group_size
+
+# jax renamed TPUCompilerParams -> CompilerParams; accept either so the
+# kernel loads against the pallas version this image ships
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
+
+# decode/verify batches are skinny (max_num_seqs, or batch * spec
+# window); anything wider is prefill-shaped and belongs on the MXU via
+# the plain dot with XLA-fused dequant
+MAX_ROWS = 256
+
+# int8 chunk: in-rows per inner grid step (int4 chunks are one scale
+# group instead, so folding stays exact per chunk)
+_INT8_CHUNK = 512
+
+
+def _pick_tn(N: int):
+    """Out-tile width: lane-dim friendly when possible."""
+    for cand in (512, 256, 128):
+        if N % cand == 0:
+            return cand
+    return N if N <= 1024 else None
+
+
+def _pick_int8_chunk(K: int):
+    for cand in (_INT8_CHUNK, 256, 128, 64):
+        if K % cand == 0:
+            return cand
+    return K if K <= _INT8_CHUNK else None
+
+
+def kernel_plan(rows: int, w: dict):
+    """(grid, tiles) for the fused kernel, or None when the shape
+    doesn't tile (the caller falls back to pure JAX).  w is a PER-LAYER
+    QTensor (2-D planes) — the scan body has already sliced the stack.
+    """
+    if rows > MAX_ROWS:
+        return None
+    if "q8" in w:
+        if w["q8"].ndim != 2:
+            return None
+        K, N = w["q8"].shape
+        tk = _pick_int8_chunk(K)
+        tn = _pick_tn(N)
+        if tk is None or tn is None:
+            return None
+        return {"kind": "int8", "K": K, "N": N, "tk": tk, "tn": tn}
+    if w["q4"].ndim != 2:
+        return None
+    Kq, N = w["q4"].shape
+    K = 2 * Kq
+    g = int4_group_size(w)
+    tn = _pick_tn(N)
+    if tn is None or g % 2 or K % g:
+        return None
+    return {"kind": "int4", "K": K, "N": N, "tk": g, "tn": tn}
+
+
+def _int8_kernel(x_ref, w_ref, s_ref, o_ref, acc_ref, *, n_chunks):
+    c = pl.program_id(1)
+
+    @pl.when(c == 0)
+    def _zero():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # dequant-after-DMA: the block arrived int8; widen in-register and
+    # fold the per-out-channel scale after the dot (exact: one scale
+    # row covers the whole contraction)
+    part = jax.lax.dot_general(
+        x_ref[:], w_ref[:].astype(x_ref.dtype),
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    acc_ref[:] += part * s_ref[0].astype(jnp.float32)
+
+    @pl.when(c == n_chunks - 1)
+    def _flush():
+        o_ref[:] = acc_ref[:].astype(o_ref.dtype)
+
+
+def _int4_kernel(xe_ref, xo_ref, w_ref, s_ref, o_ref, acc_ref, *,
+                 n_chunks):
+    c = pl.program_id(1)
+
+    @pl.when(c == 0)
+    def _zero():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # unpack both nibble planes in-register ( & 0xFF kills the int8
+    # sign extension from the widening)
+    p = w_ref[:].astype(jnp.int32) & 0xFF
+    lo = ((p & 0xF) - 8).astype(xe_ref.dtype)
+    hi = (((p >> 4) & 0xF) - 8).astype(xe_ref.dtype)
+    part = jax.lax.dot_general(
+        xe_ref[:], lo, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    part += jax.lax.dot_general(
+        xo_ref[:], hi, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    # chunk == one scale group, so the group scale folds post-dot
+    acc_ref[:] += part * s_ref[0].astype(jnp.float32)
+
+    @pl.when(c == n_chunks - 1)
+    def _flush():
+        o_ref[:] = acc_ref[:].astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def quant_matmul(x: jax.Array, w: dict, *, interpret: bool = False
+                 ) -> jax.Array:
+    """x: [rows, K] (rows <= MAX_ROWS) @ QTensor w -> [rows, N].
+
+    Caller must have checked kernel_plan(rows, w) is not None.
+    """
+    rows = x.shape[0]
+    plan = kernel_plan(rows, w)
+    if plan is None:
+        raise ValueError(
+            f"no kernel plan for rows={rows}, w shapes "
+            f"{jax.tree.map(jnp.shape, w)}")
+    K, N, tk, tn = plan["K"], plan["N"], plan["tk"], plan["tn"]
+    n_chunks = K // tk
+    grid = (N // tn, n_chunks)
+    scale = w["scale"]
+
+    if plan["kind"] == "int8":
+        kernel = functools.partial(_int8_kernel, n_chunks=n_chunks)
+        in_specs = [
+            pl.BlockSpec((rows, tk), lambda j, c: (0, c)),
+            pl.BlockSpec((tk, tn), lambda j, c: (c, j)),
+            pl.BlockSpec((1, tn), lambda j, c: (0, j)),
+        ]
+        operands = (x, w["q8"], scale.reshape(1, N))
+    else:
+        kernel = functools.partial(_int4_kernel, n_chunks=n_chunks)
+        # the two nibble-plane activations: even/odd in-rows of x
+        # (packed byte row i holds original rows 2i and 2i+1)
+        xe, xo = x[:, 0::2], x[:, 1::2]
+        tkq = tk // 2                    # packed rows per chunk
+        in_specs = [
+            pl.BlockSpec((rows, tkq), lambda j, c: (0, c)),
+            pl.BlockSpec((rows, tkq), lambda j, c: (0, c)),
+            pl.BlockSpec((tkq, tn), lambda j, c: (c, j)),
+            pl.BlockSpec((1, tn), lambda j, c: (c, j)),
+        ]
+        operands = (xe, xo, w["q4"], scale)
+
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((rows, tn), lambda j, c: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((rows, N), x.dtype),
+        scratch_shapes=[pltpu.VMEM((rows, tn), jnp.float32)],
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(*operands)
+
+
+def dequant_matmul_jax(x: jax.Array, w: dict) -> jax.Array:
+    """Pure-JAX fallback: int8 keeps the fused dequant-into-dot form
+    (XLA reads the int8 bytes and fuses the convert); int4 unpacks then
+    dots (the unpack is elementwise, so XLA can still fuse it — the
+    guarantee of reading only quantized bytes is the kernel's job)."""
+    if "q8" in w:
+        return (x @ w["q8"].astype(x.dtype)) * w["scale"].astype(x.dtype)
+    return x @ dequant_weight(w, x.dtype)
+
+
+def _impl_mode() -> str:
+    """auto | pallas | interpret | jax (trace-time escape hatch)."""
+    return os.environ.get("KAITO_QUANT_MATMUL", "auto")
+
+
+def quant_linear(x: jax.Array, w: dict) -> jax.Array:
+    """nn.linear entry point for QTensor weights: fused Pallas kernel
+    for decode-shaped calls on TPU, pure-JAX fallback otherwise.
+
+    The branch is trace-time static (shapes + backend + env), so each
+    jitted program bakes in exactly one path.
+    """
+    mode = _impl_mode()
+    lead, K = x.shape[:-1], x.shape[-1]
+    rows = 1
+    for d in lead:
+        rows *= d
+    use_kernel = False
+    if mode in ("pallas", "interpret"):
+        use_kernel = True
+    elif mode == "auto":
+        use_kernel = jax.default_backend() == "tpu"
+    if use_kernel and kernel_plan(rows, w) is not None and rows > 0:
+        interpret = (mode == "interpret"
+                     or jax.default_backend() != "tpu")
+        out = quant_matmul(x.reshape(rows, K), w, interpret=interpret)
+        return out.reshape(*lead, out.shape[-1])
+    return dequant_matmul_jax(x, w)

@@ -1,23 +1,52 @@
-"""Weight-only int8 quantization for serving.
+"""Weight-only quantization (int8 / packed int4) for serving.
 
-Per-out-channel symmetric int8: each matmul weight ``[.., in, out]``
-becomes ``{"q8": int8, "scale": f32[.., out]}``; ``nn.linear`` (and the
-MoE einsum/ragged paths) dequant on use, so under jit the int8 stays in
-HBM and the dequant fuses into the dot.  Decode is parameter-bandwidth-
-bound on TPU, so halving the weight bytes is a direct throughput lever
-— the serving counterpart of the quantized presets the reference runs
-through vLLM (``--quantization`` in inference_api.py; preset quant
-methods in presets/workspace/generator/generator.go).
+Two schemes, one QTensor convention (a dict next to the plain weights
+in the param tree, so scan/shard/donate machinery never special-cases
+them):
 
-Coverage (round 3): every family.  Dense GQA q/k/v/o + MLP gate/up/
-down; MLA's latent projections (q_a/q_b/q, kv_a, o — the absorbed
-kv_b_k/kv_b_v expansion matrices stay bf16: they multiply inside the
-attention kernels every step and are small); MoE expert stacks
-(per-(layer, expert, out-channel) scales) and shared-expert MLPs (the
+``int8`` — per-out-channel symmetric: ``[.., in, out]`` becomes
+``{"q8": int8[.., in, out], "scale": f32[.., out]}`` with
+``scale = absmax/127``.
+
+``int4`` — per-group per-out-channel symmetric (AWQ/GPTQ-style
+group scales, g=128): ``[.., in, out]`` becomes
+``{"q4": int8[.., in/2, out], "scale": f32[.., G, out]}`` where each
+int8 byte packs TWO ADJACENT in-rows (row ``2i`` in the low nibble,
+``2i+1`` in the high nibble, stored biased by +8 so a nibble is the
+unsigned value of ``q+8`` with ``q`` clipped to [-7, 7]), and
+``G = in/g`` groups of ``g`` consecutive in-rows share a scale row
+(``g = in`` — plain per-out-channel — when ``in % 128 != 0``).
+Adjacent-pair packing is load-bearing: a tensor-parallel shard of
+packed rows ``[a, b)`` corresponds to the contiguous original rows
+``[2a, 2b)``, so the packed weight shards exactly like the bf16 weight
+it replaced, and the fused kernel feeds the two nibble planes from the
+even/odd columns of x without any in-kernel interleave or transpose.
+
+Dequant happens on use: ``nn.linear`` routes QTensors through
+``engine/ops/quant_matmul.py`` — a Pallas kernel on TPU that DMAs the
+quantized slab + scale rows into VMEM and dequants in-register (the
+HBM stream is the quantized bytes by construction), with a pure-JAX
+unpack-then-dot fallback everywhere else.  Decode is parameter-
+bandwidth-bound on TPU, so int8 halves and int4 quarters the dominant
+HBM stream — the serving counterpart of the quantized presets the
+reference runs through vLLM (``--quantization`` in inference_api.py;
+preset quant methods in presets/workspace/generator/generator.go).
+
+Coverage: every family.  Dense GQA q/k/v/o + MLP gate/up/down; MLA's
+latent projections (q_a/q_b/q, kv_a, o — the absorbed kv_b_k/kv_b_v
+expansion matrices stay bf16: they multiply inside the attention
+kernels every step and are small); MoE expert stacks (per-(layer,
+expert[, group], out-channel) scales) and shared-expert MLPs (the
 router stays full precision — routing logits are quality-critical and
 tiny).  Embeddings, norms, biases, and the (often tied) lm_head stay
 bf16 — the logits matmul is quality-critical and the embedding gather
 needs the full-precision table anyway.
+
+Explicitly exempt trees: ``serve_lora`` adapter stacks (tiny, rank-r
+factors whose quality is the whole point of the adapter) and the
+draft runner's weights (``engine/spec.py`` builds its own param tree
+and never calls quantize_params — the draft is small by design and
+its acceptance rate IS the product; see docs/quantization.md).
 """
 
 from __future__ import annotations
@@ -36,9 +65,26 @@ QUANT_KEYS = (
     "shared_gate", "shared_up", "shared_down",
 )
 
+# weight-quantization schemes the engine can serve
+QUANT_SCHEMES = ("int8", "int4")
 
-def supports_quantization(arch: ModelArch) -> bool:
-    return True   # every family since round 3 (kept for API stability)
+# int4 group size: 128 in-rows share a scale row (the AWQ/GPTQ sweet
+# spot — small enough to track outliers, large enough that fp32 scales
+# add only 4/(128*0.5) ~ 6% to the packed bytes); weights whose in-dim
+# isn't a multiple fall back to one whole-column group
+INT4_GROUP = 128
+
+
+def supports_quantization(arch: ModelArch, scheme: str = "int8") -> bool:
+    """Whether ``scheme`` can quantize every QUANT_KEYS matmul of this
+    family.  int8 has no shape constraints; int4 packs two in-rows per
+    byte, so every quantized in-dim must be even (true for every
+    catalog family — hidden/intermediate/latent dims are all even)."""
+    if scheme not in QUANT_SCHEMES:
+        return False
+    if scheme == "int4":
+        return arch.hidden_size % 2 == 0
+    return True
 
 
 def is_quantized_leaf(group: str, name: str) -> bool:
@@ -52,17 +98,65 @@ def is_qtensor(w) -> bool:
     """The QTensor shape test used by every dequant-on-use call site
     (nn.linear, the MoE einsum/ragged paths) — the representation is
     defined here, next to quantize_weight."""
-    return isinstance(w, dict) and "q8" in w
+    return isinstance(w, dict) and ("q8" in w or "q4" in w)
 
 
-def qtensor_logical_axes(ax: tuple) -> dict:
-    """Logical axes for the QTensor pair produced from a weight whose
-    axes are ``ax``: q8 keeps the weight's axes; the per-out-channel
-    scale drops the contracted (in, = second-to-last) dim."""
+def qtensor_kind(w) -> str:
+    """'int8' / 'int4' for a QTensor dict, '' for anything else."""
+    if isinstance(w, dict):
+        if "q8" in w:
+            return "int8"
+        if "q4" in w:
+            return "int4"
+    return ""
+
+
+def int4_group_size(w: dict) -> int:
+    """Recover the group size from an int4 QTensor's shapes: the
+    quantizer only ever emits uniform groups (g=INT4_GROUP when the
+    in-dim divides, else one whole-column group), so g = in / G."""
+    kq = w["q4"].shape[-2]
+    return (2 * kq) // w["scale"].shape[-2]
+
+
+def qtensor_logical_axes(ax: tuple, scheme: str = "int8") -> dict:
+    """Logical axes for the QTensor produced from a weight whose axes
+    are ``ax``.  int8: q8 keeps the weight's axes, the per-out-channel
+    scale drops the contracted (in, = second-to-last) dim.  int4: q4
+    keeps the weight's axes (the packed dim is still the in axis, at
+    half length), and the scale's GROUP dim inherits the in axis's
+    assignment — group boundaries track in-rows, so a TP shard of
+    packed rows owns exactly its groups' scale rows."""
+    if scheme == "int4":
+        return {"q4": ax, "scale": ax[:-2] + (ax[-2],) + ax[-1:]}
     return {"q8": ax, "scale": ax[:-2] + ax[-1:]}
 
 
-def quantize_weight(w: jax.Array) -> dict:
+def _pack_int4(q: jax.Array) -> jax.Array:
+    """[.., in, out] int32 nibbles in [-8, 7] -> [.., in/2, out] int8.
+
+    Adjacent-pair layout: byte i = (row 2i + 8) | ((row 2i+1 + 8) << 4).
+    Stored as int8 (bitcast from uint8) so downstream plumbing sees the
+    'two nibbles per int8 byte' contract."""
+    lo = q[..., 0::2, :] + 8
+    hi = q[..., 1::2, :] + 8
+    packed = (lo.astype(jnp.uint8) | (hi.astype(jnp.uint8) << 4))
+    return jax.lax.bitcast_convert_type(packed, jnp.int8)
+
+
+def unpack_int4(q4: jax.Array) -> jax.Array:
+    """[.., in/2, out] int8 -> [.., in, out] int32 values in [-8, 7]
+    (exact inverse of _pack_int4)."""
+    p = q4.astype(jnp.int32) & 0xFF     # kill the int8 sign extension
+    lo = (p & 0xF) - 8
+    hi = ((p >> 4) & 0xF) - 8
+    # [.., in/2, 2, out] -> [.., in, out]: rows interleave back to
+    # (2i, 2i+1) order
+    stacked = jnp.stack([lo, hi], axis=-2)
+    return stacked.reshape(*q4.shape[:-2], 2 * q4.shape[-2], q4.shape[-1])
+
+
+def quantize_weight_int8(w: jax.Array) -> dict:
     """[.., in, out] bf16/f32 -> {"q8": int8, "scale": f32[.., out]}.
 
     Works for any rank: stacked layer weights [L, in, out] get
@@ -76,12 +170,63 @@ def quantize_weight(w: jax.Array) -> dict:
     return {"q8": q8, "scale": scale}
 
 
-def quantize_params(params: dict) -> dict:
+def quantize_weight_int4(w: jax.Array, group: int = INT4_GROUP) -> dict:
+    """[.., in, out] bf16/f32 -> {"q4": int8[.., in/2, out],
+    "scale": f32[.., G, out]} (see module docstring for the layout).
+
+    Nibbles are symmetric [-7, 7] (scale = group absmax / 7); -8 never
+    occurs in quantizer output, keeping the code range symmetric the
+    way the int8 path keeps [-127, 127].
+    """
+    K, N = w.shape[-2], w.shape[-1]
+    if K % 2:
+        raise ValueError(
+            f"int4 packs two in-rows per byte; in-dim {K} is odd")
+    g = group if K % group == 0 else K
+    grouped = w.astype(jnp.float32).reshape(*w.shape[:-2], K // g, g, N)
+    scale = jnp.max(jnp.abs(grouped), axis=-2) / 7.0        # [.., G, N]
+    scale = jnp.maximum(scale, 1e-8)
+    q = jnp.round(grouped / scale[..., None, :])
+    q = jnp.clip(q, -7, 7).astype(jnp.int32)
+    q = q.reshape(*w.shape[:-2], K, N)
+    return {"q4": _pack_int4(q), "scale": scale}
+
+
+def quantize_weight(w: jax.Array, scheme: str = "int8") -> dict:
+    """Scheme dispatcher (the per-tensor quantize-at-load hook jits a
+    partial of this)."""
+    if scheme == "int8":
+        return quantize_weight_int8(w)
+    if scheme == "int4":
+        return quantize_weight_int4(w)
+    raise ValueError(f"unknown quantization scheme {scheme!r} "
+                     f"(known: {', '.join(QUANT_SCHEMES)})")
+
+
+def dequant_weight(w: dict, dtype) -> jax.Array:
+    """Materialize a QTensor back to a full-precision ``[.., in, out]``
+    array — the pure-JAX fallback (XLA is free to fuse this into the
+    consuming dot) and the reference for kernel parity tests."""
+    if "q8" in w:
+        return (w["q8"].astype(jnp.float32)
+                * w["scale"][..., None, :]).astype(dtype)
+    g = int4_group_size(w)
+    q = unpack_int4(w["q4"]).astype(jnp.float32)
+    scale = jnp.repeat(w["scale"], g, axis=-2)
+    return (q * scale).astype(dtype)
+
+
+def quantize_params(params: dict, scheme: str = "int8") -> dict:
     """Quantize a serving param tree in place-shape (new tree).
 
-    Every layer group's QUANT_KEYS quantize; non-matmul leaves and
-    top-level params (embed/lm_head/final_norm) pass through.
+    Every layer group's QUANT_KEYS quantize; non-matmul leaves,
+    top-level params (embed/lm_head/final_norm) and the serve_lora
+    adapter stacks pass through.  Unknown schemes raise immediately —
+    a typo'd --quantization must never silently serve bf16.
     """
+    if scheme not in QUANT_SCHEMES:
+        raise ValueError(f"unknown quantization scheme {scheme!r} "
+                         f"(known: {', '.join(QUANT_SCHEMES)})")
     out = dict(params)
     for group, sub in params.items():
         if not isinstance(sub, dict) or group == "serve_lora":
@@ -89,6 +234,6 @@ def quantize_params(params: dict) -> dict:
         stack = dict(sub)
         for key in QUANT_KEYS:
             if key in stack and not is_qtensor(stack[key]):
-                stack[key] = quantize_weight(stack[key])
+                stack[key] = quantize_weight(stack[key], scheme)
         out[group] = stack
     return out

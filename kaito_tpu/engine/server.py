@@ -1413,6 +1413,7 @@ def load_config_file(cfg: EngineConfig, path: str) -> EngineConfig:
         "sequence_parallel_size": "sequence_parallel",
         "page-size": "page_size", "page_size": "page_size",
         "dtype": "dtype", "kv-cache-dtype": "kv_dtype",
+        "quantization": "quantization",
         "seed": "seed", "port": "port",
     }
     for k, v in (section or {}).items():
@@ -1455,7 +1456,12 @@ def main(argv=None):
              "~2x KV capacity and half the HBM read per decode step. "
              "Default/'auto' follows --dtype")
     ap.add_argument("--quantization", default=os.environ.get(
-        "KAITO_QUANTIZATION", ""), choices=["", "int8"])
+        "KAITO_QUANTIZATION", ""), choices=["", "int8", "int4"],
+        help="weight-only quantization (vLLM flag-name parity): "
+             "'int8' = per-out-channel symmetric, 'int4' = packed "
+             "two-per-byte with per-group (g=128) scales and a fused "
+             "Pallas dequant matmul on TPU (docs/quantization.md). "
+             "Default off (bf16 weights)")
     ap.add_argument("--kaito-config-file", default="")
     ap.add_argument("--kaito-adapters-dir", default="")
     ap.add_argument("--weights-dir",

@@ -37,9 +37,12 @@ WEIGHT_EXPANSION = 1.02         # loaded weights vs on-disk size
 PER_CHIP_OVERHEAD_BYTES = int(1.25 * GiB)  # XLA runtime + programs + scratch
 WEIGHT_OVERHEAD_FACTOR = 0.03   # proportional slack (buffers, donation gaps)
 
-# Bytes per weight for supported quantization schemes.
+# Bytes per weight for supported quantization schemes.  Served int4
+# (engine/quant.py) is packed nibbles + fp32 per-group scales at
+# g=128: 0.5 + 4/128 = 0.53125 — same density as mxfp4's 4.25
+# bits/weight, by coincidence of constants.
 _QUANT_BYTES = {"": 2.0, "bf16": 2.0, "fp16": 2.0, "int8": 1.0, "fp8": 1.0,
-                "mxfp4": 0.53125, "int4": 0.5}  # mxfp4: 4.25 bits/weight
+                "mxfp4": 0.53125, "int4": 0.53125}
 
 
 def weight_bytes(md: ModelMetadata, quantization: Optional[str] = None) -> int:

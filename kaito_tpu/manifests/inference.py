@@ -68,6 +68,14 @@ def build_engine_command(
         "kaito-tpu.io/kv-cache-dtype", "")
     if kv_dtype:
         args += ["--kv-cache-dtype", kv_dtype]
+    # weight-only quantization (docs/quantization.md): the controller
+    # validated the scheme at plan time (PlanFailed on unknown values),
+    # and the planner already sized node counts with the smaller
+    # weight bytes — the flag must render or the pods would serve
+    # bf16 on capacity planned for int8/int4
+    quant = ws.metadata.annotations.get("kaito-tpu.io/quantization", "")
+    if quant:
+        args += ["--quantization", quant]
     qos = ws.metadata.annotations.get("kaito-tpu.io/qos", "")
     if qos:
         args += ["--qos-config", qos]

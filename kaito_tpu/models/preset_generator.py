@@ -59,6 +59,11 @@ def main(argv=None):
                     choices=["bfloat16", "int8"],
                     help="KV pool dtype assumed by the plan preview "
                          "(int8 halves KV bytes/token)")
+    ap.add_argument("--quantization", default="",
+                    choices=["", "int8", "int4"],
+                    help="weight-only quantization assumed by the plan "
+                         "preview (int8 halves, int4 ~quarters weight "
+                         "bytes -> fewer chips; docs/quantization.md)")
     ap.add_argument("--cp-autocarve", action="store_true",
                     help="opt the plan preview into the >=32k serve CP "
                          "carve (evidence-gated off by default: BENCH_r05 "
@@ -102,13 +107,20 @@ def main(argv=None):
             return 1
 
     try:
+        from kaito_tpu.estimator.estimator import weight_bytes
         from kaito_tpu.parallel.plan import plan_parallelism
         from kaito_tpu.sku.catalog import CHIP_CATALOG
 
+        # weight-byte ladder the operator plans against (the int4 row
+        # is why a 70B fits half the chips; docs/quantization.md)
+        out["weight_bytes_bf16"] = weight_bytes(md, "bf16")
+        out["weight_bytes_int8"] = weight_bytes(md, "int8")
+        out["weight_bytes_int4"] = weight_bytes(md, "int4")
         chip = CHIP_CATALOG[args.chip]
         plan = plan_parallelism(
             md, chip,
             kv_dtype_bytes=1 if args.kv_cache_dtype == "int8" else 2,
+            quantization=args.quantization or None,
             cp_autocarve=args.cp_autocarve)
         out["plan"] = {"chip": args.chip, "topology": plan.topology,
                        "num_slices": plan.num_slices,

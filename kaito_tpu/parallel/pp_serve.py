@@ -117,12 +117,14 @@ class PipelineServeExecutor:
                 self.mesh, P(*prefix, *tuple(SERVE_RULES.spec(ax))))
 
         def entry(name, v, ax_tree, prefix=()):
-            from kaito_tpu.engine.quant import qtensor_logical_axes
+            from kaito_tpu.engine.quant import (qtensor_kind,
+                                                qtensor_logical_axes)
 
             ax = ax_tree[name]
-            if isinstance(v, dict):     # QTensor {"q8", "scale"}
+            if isinstance(v, dict):     # QTensor {"q8"|"q4", "scale"}
                 return {kk: leaf(aa, prefix)
-                        for kk, aa in qtensor_logical_axes(ax).items()}
+                        for kk, aa in qtensor_logical_axes(
+                            ax, qtensor_kind(v) or "int8").items()}
             return leaf(ax, prefix)
 
         out = {}

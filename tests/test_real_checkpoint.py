@@ -111,6 +111,31 @@ def test_int8_matches_its_golden(ckpt):
         eng.stop()
 
 
+def test_weight_int4_matches_its_golden(ckpt):
+    """int4 packed-weight serving of the real checkpoint pins to its
+    own golden (the int8 section above is the weight-int8 pin).  4-bit
+    greedy legitimately diverges from fp32 more often than int8 — what
+    must NOT happen is drift from the continuation int4 itself produced
+    at golden time, which would mean the pack/unpack/dequant path
+    changed numerically."""
+    model, golden, _ = ckpt
+    eng = _engine(model, quant="int4")
+    try:
+        for p in golden["prompts"]:
+            want = p["weight_int4"]["greedy_tokens"]
+            req = eng.submit(
+                list(p["prompt_tokens"]),
+                SamplingParams(max_tokens=len(want), temperature=0.0,
+                               ignore_eos=True, logprobs=True))
+            assert list(req.stream()) == want, p["text"]
+            got = [float(x) for x in req.output_logprobs]
+            np.testing.assert_allclose(
+                got, p["weight_int4"]["logprobs"], rtol=0, atol=2e-3,
+                err_msg=p["text"])
+    finally:
+        eng.stop()
+
+
 def test_kv_int8_matches_its_golden(ckpt):
     """int8 KV-cache serving of the real checkpoint pins to its own
     golden.  Per-page-per-head quantization error is tiny but can flip

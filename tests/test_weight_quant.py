@@ -1,0 +1,374 @@
+"""int4 packed-weight quantization + the fused dequant matmul.
+
+The weight ladder's second rung (docs/quantization.md): int4 packs two
+adjacent in-rows per int8 byte with per-group (g=128) per-out-channel
+scales, and nn.linear routes QTensors through the fused Pallas kernel
+(ops/quant_matmul.py) whose HBM stream is the quantized bytes.  These
+tests pin the pack/unpack bijection, the per-family quantizer bounds,
+kernel-vs-JAX parity (interpreter mode, so CPU CI runs the kernel
+path), quantize-at-load invariants, the control-plane plumbing
+(annotation -> flag, plan-time rejection), and the compose leg with
+int8 KV + speculation.  test_quant.py keeps the int8 coverage;
+test_real_checkpoint.py pins int4 continuations on trained weights.
+"""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kaito_tpu.engine.config import EngineConfig
+from kaito_tpu.engine.engine import InferenceEngine, SamplingParams
+from kaito_tpu.engine.ops.quant_matmul import (
+    dequant_matmul_jax, kernel_plan, quant_linear, quant_matmul)
+from kaito_tpu.engine.quant import (
+    INT4_GROUP, _pack_int4, dequant_weight, int4_group_size, is_qtensor,
+    qtensor_kind, qtensor_logical_axes, quantize_params, quantize_weight,
+    supports_quantization, unpack_int4)
+from kaito_tpu.models import get_model_by_name
+
+REPO = __file__.rsplit("/tests/", 1)[0]
+
+
+# ---------------------------------------------------------------------------
+# pack / unpack / quantizer math
+# ---------------------------------------------------------------------------
+
+def test_pack_unpack_is_exact_over_full_nibble_range():
+    """Every (lo, hi) nibble pair in [-8, 7]^2 survives the round trip
+    — including -8, which the quantizer never emits but the container
+    must still represent."""
+    vals = np.arange(-8, 8, dtype=np.int32)
+    lo, hi = np.meshgrid(vals, vals, indexing="ij")
+    q = jnp.asarray(np.stack([lo.ravel(), hi.ravel()], axis=1)
+                    .reshape(-1, 2, 1))                  # [256, 2, 1]
+    packed = _pack_int4(q)
+    assert packed.dtype == jnp.int8
+    assert packed.shape == (256, 1, 1)
+    np.testing.assert_array_equal(np.asarray(unpack_int4(packed)),
+                                  np.asarray(q))
+
+
+@pytest.mark.parametrize("shape,scale_shape", [
+    ((256, 48), (2, 48)),              # dense 2-D: K=256 -> 2 groups
+    ((3, 256, 48), (3, 2, 48)),        # stacked layers
+    ((2, 4, 384, 32), (2, 4, 3, 32)),  # MoE [layer, expert, in, out]
+    ((100, 16), (1, 16)),              # K % 128 != 0 -> one whole group
+])
+def test_int4_roundtrip_bounds_per_family(shape, scale_shape):
+    rng = np.random.RandomState(0)
+    w = jnp.asarray(rng.randn(*shape).astype(np.float32))
+    q = quantize_weight(w, "int4")
+    assert q["q4"].dtype == jnp.int8
+    assert q["q4"].shape == shape[:-2] + (shape[-2] // 2, shape[-1])
+    assert q["scale"].shape == scale_shape
+    g = int4_group_size(q)
+    assert g == (INT4_GROUP if shape[-2] % INT4_GROUP == 0 else shape[-2])
+    # symmetric 4-bit: worst-case error is scale/2 per entry, per group
+    deq = dequant_weight(q, jnp.float32)
+    per_entry_scale = jnp.repeat(q["scale"], g, axis=-2)
+    err = jnp.max(jnp.abs(deq - w) / per_entry_scale)
+    assert float(err) <= 0.5 + 1e-3
+
+
+def test_int4_rejects_odd_in_dim():
+    w = jnp.zeros((33, 16), jnp.float32)
+    with pytest.raises(ValueError, match="odd"):
+        quantize_weight(w, "int4")
+
+
+def test_unknown_scheme_raises_everywhere():
+    w = jnp.zeros((4, 4), jnp.float32)
+    with pytest.raises(ValueError, match="int3"):
+        quantize_weight(w, "int3")
+    with pytest.raises(ValueError, match="int3"):
+        quantize_params({"dense": {"q": w}}, "int3")
+    assert not supports_quantization(
+        get_model_by_name("tiny-llama-test").arch, "int3")
+
+
+def test_supports_int4_every_catalog_family():
+    for name in ("deepseek-v3-0324", "gpt-oss-20b",
+                 "llama-3.1-8b-instruct", "tiny-moe-real"):
+        assert supports_quantization(get_model_by_name(name).arch, "int4")
+
+
+def test_qtensor_kind_and_logical_axes():
+    w = jnp.asarray(np.random.RandomState(1).randn(256, 32), jnp.float32)
+    q8, q4 = quantize_weight(w, "int8"), quantize_weight(w, "int4")
+    assert is_qtensor(q8) and is_qtensor(q4) and not is_qtensor(w)
+    assert qtensor_kind(q8) == "int8" and qtensor_kind(q4) == "int4"
+    assert qtensor_kind(w) == ""
+    ax = ("layer", "model", "tensor")
+    # int4: the packed dim keeps the in axis; the scale GROUP dim
+    # inherits it too (group boundaries track in-rows, so a TP shard
+    # of packed rows owns exactly its groups' scale rows)
+    assert qtensor_logical_axes(ax, "int4") == {
+        "q4": ax, "scale": ("layer", "model", "tensor")}
+    assert qtensor_logical_axes(ax, "int8") == {
+        "q8": ax, "scale": ("layer", "tensor")}
+
+
+# ---------------------------------------------------------------------------
+# fused kernel vs pure-JAX fallback (interpreter mode: CPU runs the
+# kernel path end-to-end)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("scheme", ["int8", "int4"])
+@pytest.mark.parametrize("rows,K,N", [
+    (1, 256, 128),     # pure GEMV
+    (4, 128, 48),      # one int4 group, ragged N tile
+    (8, 512, 256),     # multiple chunks/groups x multiple out tiles
+    (3, 100, 16),      # odd everything (int4: single whole-K group)
+])
+def test_kernel_parity_interpret_vs_jax(scheme, rows, K, N):
+    if scheme == "int4" and K % 2:
+        pytest.skip("odd K cannot pack")
+    rng = np.random.RandomState(rows * K + N)
+    x = jnp.asarray(rng.randn(rows, K).astype(np.float32))
+    w = quantize_weight(jnp.asarray(rng.randn(K, N).astype(np.float32)),
+                        scheme)
+    assert kernel_plan(rows, w) is not None
+    got = quant_matmul(x, w, interpret=True)
+    want = dequant_matmul_jax(x, w)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_kernel_plan_gates_prefill_and_stacked_shapes():
+    w = quantize_weight(jnp.zeros((256, 128), jnp.float32), "int4")
+    assert kernel_plan(257, w) is None          # wider than decode
+    stacked = quantize_weight(jnp.zeros((3, 256, 128), jnp.float32),
+                              "int4")
+    assert kernel_plan(4, stacked) is None      # scan slices first
+
+
+def test_quant_linear_env_override_runs_kernel(monkeypatch):
+    """KAITO_QUANT_MATMUL=interpret forces the kernel (interpreter) on
+    CPU and must agree with the fallback, including leading-dim
+    flattening."""
+    monkeypatch.setenv("KAITO_QUANT_MATMUL", "interpret")
+    rng = np.random.RandomState(7)
+    x = jnp.asarray(rng.randn(2, 3, 256).astype(np.float32))
+    for scheme in ("int8", "int4"):
+        w = quantize_weight(
+            jnp.asarray(rng.randn(256, 128).astype(np.float32)), scheme)
+        got = quant_linear(x, w)
+        want = dequant_matmul_jax(x.reshape(6, 256), w).reshape(2, 3, 128)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# engine integration: quantize-at-load, byte accounting, MoE, compose
+# ---------------------------------------------------------------------------
+
+def _tree_bytes(params):
+    return sum(x.nbytes for x in jax.tree.leaves(params))
+
+
+def test_int4_quantize_on_load_matches_post_load_quantize(tmp_path):
+    """--quantization int4 quantizes PER TENSOR as the checkpoint
+    streams in; the result must be bit-identical to load-then-quantize
+    (same invariant test_quant.py pins for int8)."""
+    from safetensors.numpy import save_file
+
+    from kaito_tpu.engine.model import TransformerLM
+    from kaito_tpu.engine.weights import (export_hf_state_dict,
+                                          load_safetensors_params)
+
+    md = get_model_by_name("tiny-llama-test")
+    model = TransformerLM(md.arch, dtype=jnp.float32)
+    params = jax.jit(model.init_params)(jax.random.PRNGKey(3))
+    save_file(export_hf_state_dict(model, params),
+              str(tmp_path / "model.safetensors"))
+
+    base = dict(model="tiny-llama-test", max_num_seqs=2, max_model_len=128,
+                dtype="float32", kv_dtype="float32",
+                enable_prefix_caching=False, weights_dir=str(tmp_path))
+    eng = InferenceEngine(EngineConfig(**base, quantization="int4"))
+    qt = eng.params["dense"]["q"]
+    assert qtensor_kind(qt) == "int4"
+
+    from functools import partial
+
+    ref = jax.jit(partial(quantize_params, scheme="int4"))(
+        load_safetensors_params(model, str(tmp_path)))
+    np.testing.assert_array_equal(np.asarray(qt["q4"]),
+                                  np.asarray(ref["dense"]["q"]["q4"]))
+    np.testing.assert_allclose(
+        np.asarray(eng.params["dense"]["down"]["scale"]),
+        np.asarray(ref["dense"]["down"]["scale"]), rtol=1e-6)
+
+    req = eng.submit([5, 7, 9], SamplingParams(max_tokens=4,
+                                               temperature=0.0,
+                                               ignore_eos=True))
+    for _ in range(100):
+        eng.step()
+        if req.finish_reason:
+            break
+    assert len(req.output_tokens) == 4
+
+
+def test_int4_param_bytes_below_int8_below_fp32():
+    """The point of the ladder: each rung strictly shrinks the HBM-
+    resident weight bytes, int4 landing under 60% of int8 on the
+    quantized leaves (0.5 + group-scale overhead)."""
+    base = dict(model="tiny-llama-test", max_num_seqs=2, max_model_len=256,
+                dtype="float32", kv_dtype="float32",
+                enable_prefix_caching=False)
+    sizes = {}
+    for scheme in ("", "int8", "int4"):
+        eng = InferenceEngine(EngineConfig(**base, quantization=scheme))
+        sizes[scheme] = _tree_bytes(eng.params)
+        if scheme:
+            qt = eng.params["dense"]["q"]
+            sizes[scheme + "_leaf"] = qt[
+                "q4" if scheme == "int4" else "q8"].nbytes
+    assert sizes["int4"] < sizes["int8"] < sizes[""]
+    assert sizes["int4_leaf"] * 2 == sizes["int8_leaf"]
+
+
+def test_moe_engine_serves_int4():
+    """MoE expert stacks pack (per-(layer, expert, group, out) scales)
+    and the grouped-matmul path dequants on use; the router stays full
+    precision.  Token-level quality on trained MoE weights pins in
+    test_real_checkpoint.py."""
+    cfg = EngineConfig(model="tiny-moe-real", max_num_seqs=2,
+                       max_model_len=256, dtype="float32",
+                       kv_dtype="float32", quantization="int4")
+    eng = InferenceEngine(cfg)
+    moe_group = next(g for g, sub in eng.params.items()
+                     if isinstance(sub, dict) and "experts_gate" in sub)
+    qt = eng.params[moe_group]["experts_gate"]
+    assert qtensor_kind(qt) == "int4"
+    assert qt["q4"].shape[-2] * 2 == qt["scale"].shape[-2] * \
+        int4_group_size(qt)
+    assert not isinstance(eng.params[moe_group]["router"], dict)
+    req = eng.submit([5, 7, 11], SamplingParams(max_tokens=4,
+                                                temperature=0.0,
+                                                ignore_eos=True))
+    guard = 0
+    while not req.finish_reason and guard < 200:
+        eng.step()
+        guard += 1
+    assert len(req.output_tokens) == 4
+
+
+def test_int4_kv_int8_spec_decode_compose():
+    """The full stack composes: int4 weights + int8 KV pages + n-gram
+    speculation must emit the SAME greedy tokens as the same quantized
+    engine without speculation (speculative exactness is scheme-
+    agnostic — verification runs the same int4 matmuls)."""
+    ckpt = os.path.join(REPO, "checkpoints", "tiny-llama-real")
+    if not os.path.exists(os.path.join(ckpt, "model.safetensors")):
+        pytest.skip("no committed checkpoint")
+    base = dict(model="tiny-llama-real", weights_dir=ckpt,
+                dtype="float32", kv_dtype="int8", quantization="int4",
+                max_model_len=512, max_num_seqs=2,
+                prefill_buckets=(64, 128), enable_prefix_caching=False,
+                seed=0)
+    outs = []
+    for spec in (0, 4):
+        eng = InferenceEngine(EngineConfig(**base,
+                                           speculative_ngram=spec))
+        eng.start()
+        try:
+            toks = eng.tokenizer.encode("the library of the library of ")
+            req = eng.submit(toks, SamplingParams(
+                max_tokens=16, temperature=0.0, ignore_eos=True))
+            outs.append(list(req.stream()))
+        finally:
+            eng.stop()
+    assert outs[0] == outs[1]
+    assert len(outs[0]) == 16
+
+
+def test_engine_rejects_unknown_scheme():
+    with pytest.raises(ValueError, match="int3"):
+        InferenceEngine(EngineConfig(model="tiny-llama-test",
+                                     max_num_seqs=2, max_model_len=128,
+                                     quantization="int3"))
+
+
+# ---------------------------------------------------------------------------
+# control plane: annotation -> flag, plan-time validation
+# ---------------------------------------------------------------------------
+
+def test_quantization_annotation_renders_engine_flag():
+    from kaito_tpu.api import (InferenceSpec, ObjectMeta, ResourceSpec,
+                               Workspace)
+    from kaito_tpu.manifests.inference import build_engine_command
+    from kaito_tpu.models.registry import get_model_by_name as _get
+    from kaito_tpu.parallel.plan import plan_parallelism
+    from kaito_tpu.sku.catalog import CHIP_CATALOG
+
+    md = _get("llama-3.1-8b-instruct")
+    plan = plan_parallelism(md, CHIP_CATALOG["v5e"], workload="serve",
+                            max_model_len=2048)
+    ws = Workspace(
+        ObjectMeta(name="wq", annotations={
+            "kaito-tpu.io/quantization": "int4"}),
+        resource=ResourceSpec(instance_type="ct5lp-hightpu-4t"),
+        inference=InferenceSpec(preset="llama-3.1-8b-instruct"))
+    cmd = build_engine_command(ws, md, plan)
+    assert cmd[cmd.index("--quantization") + 1] == "int4"
+    # no annotation -> no flag (bf16 serving stays the default)
+    ws.metadata.annotations = {}
+    assert "--quantization" not in build_engine_command(ws, md, plan)
+
+
+def test_workspace_plan_fails_on_bad_quantization_annotation():
+    from kaito_tpu.api import (InferenceSpec, ObjectMeta, ResourceSpec,
+                               Workspace)
+    from kaito_tpu.api.workspace import COND_RESOURCE_READY
+    from kaito_tpu.controllers.runtime import Store
+    from kaito_tpu.controllers.workspace import WorkspaceReconciler
+    from kaito_tpu.provision import FakeCloud, KarpenterTPUProvisioner
+
+    store = Store()
+    cloud = FakeCloud(store)
+    rec = WorkspaceReconciler(store, KarpenterTPUProvisioner(store))
+    store.create(Workspace(
+        ObjectMeta(name="bad-quant", annotations={
+            "kaito-tpu.io/quantization": "fp8"}),
+        resource=ResourceSpec(instance_type="ct5lp-hightpu-1t"),
+        inference=InferenceSpec(preset="llama-3.1-8b-instruct")))
+    for _ in range(3):
+        rec.reconcile_key("default", "bad-quant")
+        cloud.tick()
+    ws = store.get("Workspace", "default", "bad-quant")
+    cond = next((c for c in ws.status.conditions
+                 if c.type == COND_RESOURCE_READY), None)
+    assert cond is not None and cond.status == "False"
+    assert cond.reason == "PlanFailed"
+    assert "fp8" in cond.message and "int4" in cond.message
+    assert any(e.reason == "PlanFailed"
+               for e in store.events.events(name="bad-quant"))
+
+
+def test_valid_quantization_annotation_plans_clean():
+    """int4 annotation must NOT trip PlanFailed — and the planner sees
+    the smaller weight bytes (the estimator wiring the node-count
+    shrink rides on)."""
+    from kaito_tpu.api import (InferenceSpec, ObjectMeta, ResourceSpec,
+                               Workspace)
+    from kaito_tpu.controllers.runtime import Store
+    from kaito_tpu.controllers.workspace import WorkspaceReconciler
+    from kaito_tpu.provision import FakeCloud, KarpenterTPUProvisioner
+
+    store = Store()
+    cloud = FakeCloud(store)
+    rec = WorkspaceReconciler(store, KarpenterTPUProvisioner(store))
+    store.create(Workspace(
+        ObjectMeta(name="ok-quant", annotations={
+            "kaito-tpu.io/quantization": "int4"}),
+        resource=ResourceSpec(instance_type="ct5lp-hightpu-4t"),
+        inference=InferenceSpec(preset="llama-3.1-8b-instruct")))
+    for _ in range(3):
+        rec.reconcile_key("default", "ok-quant")
+        cloud.tick()
+    ws = store.get("Workspace", "default", "ok-quant")
+    assert not any(c.reason == "PlanFailed"
+                   for c in ws.status.conditions)
