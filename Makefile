@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: all native unit-test unit-test-fast unit-test-slow engine-test rag-test chaos kvq wquant kvpool obs slo fleet autoscale spec qos bench serve manager epp clean
+.PHONY: all native unit-test unit-test-fast unit-test-slow engine-test rag-test chaos kvq wquant kvpool lora obs slo fleet autoscale spec qos bench serve manager epp clean
 
 all: native
 
@@ -56,6 +56,15 @@ wquant:
 # survives-scale-out e2e is the slow leg
 kvpool:
 	$(PYTHON) -m pytest tests/test_kv_pool.py -q -m "not slow"
+
+# multi-LoRA suite (docs/multi-lora.md): adapter-cache refusals +
+# LRU/pinning/host tier, heterogeneous-batch greedy equivalence,
+# zero-retrace pin, int8-KV x spec compose, hash-chain isolation,
+# /v1/adapters + tenant mapping, annotation render/plan validation,
+# EPP affinity scoring — fast tier; the hot-load-then-affinity-routes
+# e2e over two real engines is the slow leg
+lora:
+	$(PYTHON) -m pytest tests/test_multi_lora.py -q -m "not slow"
 
 # observability suite (docs/observability.md): tracing, flight
 # recorder, router metrics, exposition-format invariants, control-plane

@@ -432,6 +432,16 @@ def orchestrate(args):
             merged.setdefault("errors", []).append(res["error"])
         save_partial()
 
+    # --- phase: multi-LoRA hot-load + adapter decode (docs/multi-lora.md) ---
+    if not args.skip_lora_bench and remaining() > 90:
+        extra = ["--force-cpu"] if args.force_cpu else []
+        res = run_phase("lora", extra, min(remaining(), 300.0))
+        if "error" not in res:
+            merged.update(res)
+        else:
+            merged.setdefault("errors", []).append(res["error"])
+        save_partial()
+
     # --- phase: context-parallel prefill scaling (virtual 8-dev mesh) ---
     if not args.skip_cp_bench and remaining() > 120:
         res = run_phase("cp", ["--cp-tokens", str(args.cp_tokens)],
@@ -1420,12 +1430,113 @@ def phase_kvpool(args):
         b_eng.stop()
 
 
+def phase_lora(args):
+    """Multi-LoRA serving (docs/multi-lora.md): hot-load latency into
+    the HBM slot table, the zero-retrace pin across the load, base vs
+    adapter vs heterogeneous-batch decode throughput (the slot-gather
+    overhead), and host-tier fault-back-in latency after an eviction.
+    Runs on the tiny test model: the adapter path's costs are the slot
+    table and gather, not model FLOPs."""
+    _init_jax(force_cpu=args.force_cpu)
+    import shutil
+    import tempfile
+    import urllib.request
+
+    import jax as _jax
+    import jax.numpy as jnp
+
+    from kaito_tpu.engine.config import EngineConfig
+    from kaito_tpu.engine.engine import InferenceEngine
+    from kaito_tpu.engine.model import TransformerLM
+    from kaito_tpu.engine.server import make_server
+    from kaito_tpu.models import get_model_by_name
+    from kaito_tpu.tuning.lora import LoraConfig, add_lora_params, save_adapter
+
+    arch = get_model_by_name("tiny-llama-test").arch
+    root = tempfile.mkdtemp(prefix="kaito-lora-bench-")
+
+    def make_adapter(name, seed, r=8):
+        model = TransformerLM(arch, dtype=jnp.float32)
+        params = add_lora_params(
+            model, model.init_params(_jax.random.PRNGKey(0)),
+            LoraConfig(r=r), _jax.random.PRNGKey(seed))
+        save_adapter(os.path.join(root, name), params, LoraConfig(r=r),
+                     "tiny-llama-test")
+
+    for i, name in enumerate(("bench-a", "bench-b", "bench-c")):
+        make_adapter(name, seed=i + 1)
+
+    cfg = EngineConfig(model="tiny-llama-test", max_model_len=256,
+                       page_size=16, max_num_seqs=4, dtype="float32",
+                       kv_dtype="float32", prefill_buckets=(64,), seed=0,
+                       adapter_slots=2, adapter_rmax=8)
+    eng = InferenceEngine(cfg)
+    eng.start()
+    srv = make_server(eng, cfg, host="127.0.0.1", port=0)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{srv.server_address[1]}"
+
+    def post(path, body):
+        req = urllib.request.Request(
+            url + path, data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        return json.loads(urllib.request.urlopen(req, timeout=120).read())
+
+    def completion_tok_s(model_field, n=64):
+        t0 = time.monotonic()
+        post("/v1/completions", {"model": model_field,
+                                 "prompt": "adapter bench " * 8,
+                                 "max_tokens": n, "temperature": 0.0})
+        return n / (time.monotonic() - t0)
+
+    out: dict = {}
+    try:
+        completion_tok_s("tiny-llama-test", 16)      # warm the jit cache
+        traces0 = eng._decode_fn._cache_size()
+        t0 = time.monotonic()
+        post("/v1/adapters", {"name": "bench-a",
+                              "source": os.path.join(root, "bench-a")})
+        out["lora_hot_load_s"] = time.monotonic() - t0
+        out["lora_base_tok_s"] = completion_tok_s("tiny-llama-test")
+        out["lora_adapter_tok_s"] = completion_tok_s("bench-a")
+        # heterogeneous batch: base + adapter decoding concurrently
+        t0 = time.monotonic()
+        threads = [threading.Thread(target=completion_tok_s, args=(m,))
+                   for m in ("tiny-llama-test", "bench-a")]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        out["lora_hetero_tok_s"] = 128 / (time.monotonic() - t0)
+        # fill both slots, demoting bench-a to the host tier ...
+        for name in ("bench-b", "bench-c"):
+            post("/v1/adapters", {"name": name,
+                                  "source": os.path.join(root, name)})
+        def snapshot():
+            with urllib.request.urlopen(url + "/v1/adapters",
+                                        timeout=10) as r:
+                return json.loads(r.read())
+
+        out["lora_host_tier"] = snapshot()["host_tier"]
+        # ... then time the fault-back-in on the request path
+        t0 = time.monotonic()
+        completion_tok_s("bench-a", 4)
+        out["lora_fault_in_e2e_s"] = time.monotonic() - t0
+        out["lora_faults_total"] = snapshot()["faults_total"]
+        out["lora_retraces"] = eng._decode_fn._cache_size() - traces0
+        print(json.dumps(out), flush=True)
+    finally:
+        srv.shutdown()
+        eng.stop()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--phase", default="",
                     choices=["", "watch", "probe", "raw", "serve",
                              "int8_8b", "pd", "cp", "prefix", "kvpool",
-                             "wquant_quality"])
+                             "lora", "wquant_quality"])
     ap.add_argument("--cp-tokens", type=int, default=8192)
     ap.add_argument("--cp-attn-only", action="store_true",
                     help="cp phase: measure only the per-chip shard-"
@@ -1464,6 +1575,9 @@ def main():
     ap.add_argument("--skip-server-bench", action="store_true")
     ap.add_argument("--skip-int8-8b", action="store_true")
     ap.add_argument("--skip-pd-bench", action="store_true")
+    ap.add_argument("--skip-lora-bench", action="store_true",
+                    help="skip the multi-LoRA hot-load/adapter-decode "
+                         "legs (docs/multi-lora.md)")
     ap.add_argument("--deadline", type=float, default=1500.0)
     args = ap.parse_args()
 
@@ -1485,6 +1599,8 @@ def main():
         phase_pd(args)
     elif args.phase == "kvpool":
         phase_kvpool(args)
+    elif args.phase == "lora":
+        phase_lora(args)
     elif args.phase == "cp":
         phase_cp(args)
     else:

@@ -247,9 +247,18 @@ class InferenceSetReconciler(Reconciler):
             iset.spec.template.annotations.get("kaito-tpu.io/kv-pool")
             or iset.metadata.annotations.get("kaito-tpu.io/kv-pool")
             or "").lower() in ("true", "1", "on", "enabled")
+        # same coupling for multi-LoRA: the kaito-tpu.io/adapters
+        # document the template renders into --adapter-slots on the
+        # engines arms the picker's /v1/adapters residency scraper +
+        # adapter-affinity scorer (docs/multi-lora.md)
+        adapter_affinity = bool(str(
+            iset.spec.template.annotations.get("kaito-tpu.io/adapters")
+            or iset.metadata.annotations.get("kaito-tpu.io/adapters")
+            or "").strip())
         objs = generate_epp_workload(
             f"{iset.metadata.name}-epp", ns, backends=backends,
             draining=draining, kv_pool=kv_pool,
+            adapter_affinity=adapter_affinity,
             owner={"kind": "InferenceSet", "name": iset.metadata.name})
         for obj in objs:
             existing = self.store.try_get(obj.kind, ns, obj.metadata.name)

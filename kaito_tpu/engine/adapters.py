@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import logging
 import os
+from typing import Optional
 
 import jax.numpy as jnp
 import numpy as np
@@ -39,8 +40,9 @@ def discover_adapters(adapters_dir: str) -> dict[str, str]:
     return found
 
 
-def load_adapter_stacks(model, adapters_dir: str,
-                        base_model: str = "") -> tuple[dict, dict]:
+def load_adapter_stacks(model, adapters_dir: str, base_model: str = "",
+                        allow_base_mismatch: bool = False,
+                        refusals: Optional[dict] = None) -> tuple[dict, dict]:
     """Build the serve-time stacked LoRA buffers.
 
     Returns ``(serve_lora, name_to_index)`` where serve_lora is
@@ -48,8 +50,20 @@ def load_adapter_stacks(model, adapters_dir: str,
     (adapter 0 all-zeros = base model; alpha/r scaling folded into B)
     and name_to_index maps adapter names to their runtime index.
     Empty dicts when no adapters are present.
+
+    An adapter whose recorded base model disagrees with the serving
+    model is REFUSED (skipped and counted into ``refusals`` under
+    ``"base_mismatch"`` — the kaito:adapter_load_failures_total label)
+    rather than warned about and served: a wrong-base delta silently
+    degrades every response routed at it.  ``allow_base_mismatch``
+    (--adapter-allow-base-mismatch) restores the old behavior for
+    intentionally cross-based adapters.
     """
     from kaito_tpu.tuning.lora import load_adapter
+
+    def _count(reason: str) -> None:
+        if refusals is not None:
+            refusals[reason] = refusals.get(reason, 0) + 1
 
     if model.is_mla:
         # the MLA layer body has no multi-LoRA sites yet; refusing to
@@ -66,9 +80,18 @@ def load_adapter_stacks(model, adapters_dir: str,
             adapter, cfg, base = load_adapter(path)
         except Exception:
             logger.exception("skipping unreadable adapter %s", name)
+            _count("unreadable")
             continue
         if base and base_model and base != base_model:
-            logger.warning("adapter %s targets base %s, serving %s",
+            if not allow_base_mismatch:
+                logger.warning(
+                    "refusing adapter %s: targets base %s, serving %s "
+                    "(pass --adapter-allow-base-mismatch to serve it "
+                    "anyway)", name, base, base_model)
+                _count("base_mismatch")
+                continue
+            logger.warning("adapter %s targets base %s, serving %s "
+                           "(allowed by --adapter-allow-base-mismatch)",
                            name, base, base_model)
         loaded.append((name, adapter, cfg))
     if not loaded:

@@ -88,6 +88,25 @@ class EngineConfig:
     port: int = 5000
     served_model_name: str = ""
     adapters_dir: str = ""               # LoRA adapter discovery dir
+    # dynamic multi-LoRA serving (docs/multi-lora.md): a fixed-capacity
+    # HBM slot table of stacked adapter factors sized [L, slots+1, in,
+    # rmax] at boot, so hot-loading an adapter over /v1/adapters is an
+    # in-place buffer write — zero recompiles — and eviction demotes to
+    # a host-RAM LRU tier that faults back in on the next request.
+    # 0 = off: the static boot-discovery path (and the /v1/adapters 403,
+    # the metrics exposition) stay byte-identical to before.
+    adapter_slots: int = 0
+    adapter_rmax: int = 16               # max servable adapter rank
+    adapter_host_bytes: int = 256 << 20  # host-RAM overflow tier budget
+    # base-model mismatch is load-REFUSAL (counted as
+    # kaito:adapter_load_failures_total{reason="base_mismatch"}) unless
+    # this escape hatch is set — serving wrong-base deltas silently was
+    # the old (round-1) warning behavior
+    adapter_allow_base_mismatch: bool = False
+    # comma-separated URL/scheme prefixes POST /v1/adapters may pull
+    # from ("" = local paths only, same trust model as
+    # pd_source_allowlist)
+    adapter_source_allowlist: str = ""
     weights_dir: str = ""                # safetensors checkpoint dir ("" = synthetic)
     disable_rate_limit: bool = False
     enable_prefix_caching: bool = True   # native radix-tree prefix reuse

@@ -372,7 +372,46 @@ class InferenceEngine:
                     2 * self.cache.k.nbytes / 2**30)
         self.adapter_index: dict[str, int] = {}
         self.adapters_merged = False
-        if cfg.adapters_dir:
+        self.adapter_cache = None
+        # load-refusal reasons -> counts (the
+        # kaito:adapter_load_failures_total{reason} family; shared with
+        # the cache's own counter dict when the cache is on)
+        self.adapter_load_failures: dict[str, int] = {}
+        if (getattr(cfg, "adapter_slots", 0) > 0 and self.pp_exec is None
+                and not self.model.is_mla):
+            # dynamic multi-LoRA (docs/multi-lora.md): fixed-capacity
+            # slot table sized NOW so /v1/adapters hot-loads are pure
+            # in-place buffer writes — no recompiles, no restarts
+            from kaito_tpu.engine.adapter_cache import (AdapterCache,
+                                                        AdapterLoadError)
+            from kaito_tpu.engine.adapters import discover_adapters
+
+            self.adapter_cache = AdapterCache(
+                self.model, slots=cfg.adapter_slots,
+                rmax=getattr(cfg, "adapter_rmax", 16),
+                base_model=self.md.name,
+                host_bytes=getattr(cfg, "adapter_host_bytes", 0),
+                allow_base_mismatch=getattr(
+                    cfg, "adapter_allow_base_mismatch", False),
+                mesh=self.mesh)
+            self.adapter_cache.busy_fn = self._adapter_busy
+            self.adapter_load_failures = self.adapter_cache.load_failures
+            # adapter_index IS the cache's residency map (same dict,
+            # mutated in place by hot-load/evict)
+            self.adapter_index = self.adapter_cache.name_to_slot
+            for name, path in discover_adapters(cfg.adapters_dir).items():
+                try:
+                    self.adapter_cache.load_from_path(name, path)
+                except AdapterLoadError:
+                    pass        # counted + logged by the cache
+            self.params = {**self.params,
+                           "serve_lora": self.adapter_cache.serve_lora}
+        elif cfg.adapters_dir or getattr(cfg, "adapter_slots", 0) > 0:
+            if getattr(cfg, "adapter_slots", 0) > 0:
+                logger.warning(
+                    "adapter cache requested but unsupported on this "
+                    "engine (PP or MLA); falling back to boot-time "
+                    "adapter discovery")
             from kaito_tpu.engine.adapters import (
                 apply_adapters_to_params,
                 discover_adapters,
@@ -380,7 +419,10 @@ class InferenceEngine:
             )
 
             serve_lora, self.adapter_index = load_adapter_stacks(
-                self.model, cfg.adapters_dir, self.md.name)
+                self.model, cfg.adapters_dir, self.md.name,
+                allow_base_mismatch=getattr(
+                    cfg, "adapter_allow_base_mismatch", False),
+                refusals=self.adapter_load_failures)
             if serve_lora:
                 if self.mesh is not None:
                     # adapter factors are tiny; replicate across the
@@ -1291,8 +1333,7 @@ class InferenceEngine:
                tenant: str = "", priority: str = "",
                pool_blocks: Optional[list] = None) -> Request:
         self._validate_submit(prompt_tokens, params)
-        if adapter and adapter not in self.adapter_index:
-            raise ValueError(f"unknown adapter {adapter!r}")
+        self._resolve_adapter(adapter)
         rid = req_id or f"req-{self.counters['requests_total']}"
         t, prio = self._resolve_qos(tenant, priority)
         req = Request(rid,
@@ -1334,14 +1375,18 @@ class InferenceEngine:
                               timeout_s: Optional[float] = None,
                               trace_id: Optional[str] = None,
                               tenant: str = "",
-                              priority: str = "") -> Request:
+                              priority: str = "",
+                              adapter: str = "") -> Request:
         """Colocated decode entry: the prefill engine lives in THIS
         process, so its staged canonical KV slab hands off as a single
         device-to-device scatter — no host bounce, no wire (the
         reference's NIXL device path,
         preset_inferences.go:909-938, re-imagined for a shared slice).
-        ``slabs`` is ``StagedExport.device_slabs()``."""
+        ``slabs`` is ``StagedExport.device_slabs()``.  ``adapter``
+        continues decode under the prefill's adapter (the server
+        enforces the staged-meta match before calling)."""
         self._validate_submit(prompt_tokens, params)
+        self._resolve_adapter(adapter)
         # fail in the REQUEST thread, not the scheduler: a token count,
         # page_size or head layout that disagrees with the staged slab
         # would otherwise raise in _start_device_import on the engine
@@ -1351,7 +1396,7 @@ class InferenceEngine:
         rid = req_id or f"pd-{self.counters['requests_total']}"
         t, prio = self._resolve_qos(tenant, priority)
         req = Request(rid,
-                      list(prompt_tokens), params,
+                      list(prompt_tokens), params, adapter=adapter,
                       kv_device=(meta, slabs, first_token),
                       deadline=self._deadline_for(timeout_s),
                       trace_id=trace_id or meta.get("trace_id") or rid,
@@ -1366,7 +1411,8 @@ class InferenceEngine:
                                deadline_s: float = 120.0,
                                timeout_s: Optional[float] = None,
                                trace_id: Optional[str] = None,
-                               tenant: str = "", priority: str = ""):
+                               tenant: str = "", priority: str = "",
+                               adapter: str = ""):
         """Decode-role entry for the CHUNKED transfer path: the request
         is admitted immediately and its KV chunks are scattered by the
         scheduler loop as the caller ``feed``s them into the returned
@@ -1376,11 +1422,12 @@ class InferenceEngine:
         from kaito_tpu.engine.pd import ChunkedImport
 
         self._validate_submit(prompt_tokens, params)
+        self._resolve_adapter(adapter)
         self._validate_kv_meta(meta, len(prompt_tokens))
         rid = req_id or f"pd-{self.counters['requests_total']}"
         t, prio = self._resolve_qos(tenant, priority)
         req = Request(rid,
-                      list(prompt_tokens), params,
+                      list(prompt_tokens), params, adapter=adapter,
                       kv_chunked=ChunkedImport(meta, list(plans), first_token,
                                                deadline_s=deadline_s),
                       deadline=self._deadline_for(timeout_s),
@@ -1412,11 +1459,19 @@ class InferenceEngine:
         from kaito_tpu.engine.pd import ChunkedImport
 
         self._validate_submit(prompt_tokens, params)
-        if adapter and adapter not in self.adapter_index:
-            raise ValueError(f"unknown adapter {adapter!r}")
+        self._resolve_adapter(adapter)
         if meta.get("model") not in ("", None, self.md.name):
             raise ValueError(f"KV pool model mismatch: {meta.get('model')} "
                              f"!= {self.md.name}")
+        # pool keys fold the adapter into the hash chain, so a fetch
+        # can only name a same-adapter entry — but the meta check stays
+        # the authority (hash collisions, hand-rolled clients): KV
+        # computed under another adapter's deltas must never import
+        if str(meta.get("adapter") or "") != (adapter or ""):
+            raise ValueError(
+                f"KV pool adapter mismatch: entry "
+                f"{meta.get('adapter') or 'base'!r} vs request "
+                f"{adapter or 'base'!r}")
         wire_dt = meta.get("dtype")
         if wire_dt is not None \
                 and np.dtype(wire_dt) != np.dtype(self.cache.k.dtype):
@@ -1442,6 +1497,70 @@ class InferenceEngine:
                       pool_blocks=list(pool_blocks or []))
         self._enqueue(req)
         return req
+
+    # -- dynamic multi-LoRA admin (docs/multi-lora.md) ---------------------
+
+    def _resolve_adapter(self, adapter: str) -> None:
+        """Validate (and, with the cache, fault-in) an adapter for a
+        submission.  A host-tier adapter is re-installed into an HBM
+        slot HERE — in the request thread, before admission — so the
+        scheduler never sees a name without a slot index."""
+        if not adapter:
+            return
+        if self.adapter_cache is not None:
+            try:
+                self.adapter_cache.ensure(adapter)
+            except KeyError:
+                raise ValueError(f"unknown adapter {adapter!r}") from None
+        elif adapter not in self.adapter_index:
+            raise ValueError(f"unknown adapter {adapter!r}")
+
+    def _adapter_busy(self, name: str) -> bool:
+        """In-flight work references this adapter: an active decode
+        slot selects its lane, or a queued request names it.  Busy
+        adapters are pinned — the cache refuses to evict or overwrite
+        them (swapping factors under a live sequence would change its
+        weights mid-generation)."""
+        # boot-time preloads run before the batch state and queues
+        # exist: nothing can be in flight yet, so nothing is pinned
+        if getattr(self, "active", None) is None:
+            return False
+        idx = self.adapter_index.get(name)
+        if idx:
+            act, sa = self.active, self.slot_adapters
+            if any(bool(act[i]) and int(sa[i]) == idx
+                   for i in range(len(act))):
+                return True
+        with self._lock:
+            if any(r.adapter == name for r in self.waiting):
+                return True
+            for q in self._tenant_queues.values():
+                if any(r.adapter == name for r in q):
+                    return True
+        return False
+
+    def adapter_snapshot(self) -> Optional[dict]:
+        """The ``GET /v1/adapters`` payload; None when the cache is off
+        (the server answers 403 — same gating as the KV pool)."""
+        if self.adapter_cache is None:
+            return None
+        return self.adapter_cache.snapshot()
+
+    def load_adapter_dynamic(self, name: str, path: str) -> int:
+        """Hot-load an adapter artifact directory into an HBM slot (the
+        POST /v1/adapters entry).  Raises AdapterLoadError (a
+        ValueError) on refusal, AdapterBusyError when every slot is
+        pinned by in-flight work."""
+        if self.adapter_cache is None:
+            raise RuntimeError("adapter cache is not enabled")
+        return self.adapter_cache.load_from_path(name, path)
+
+    def delete_adapter(self, name: str) -> bool:
+        """Drop an adapter from both cache tiers (DELETE /v1/adapters).
+        Raises AdapterBusyError while in-flight requests pin it."""
+        if self.adapter_cache is None:
+            raise RuntimeError("adapter cache is not enabled")
+        return self.adapter_cache.remove(name)
 
     def abort(self, req: Request) -> None:
         """Request cancellation; the scheduler retires the slot at its
@@ -3291,12 +3410,18 @@ class InferenceEngine:
                 # touches the host (the NIXL-device-path analogue)
                 with self.tracer.span("kv.export.stage", req.trace_id,
                                       pages=n_pages):
-                    self.kv_exports.put(req.req_id, stage_export(
+                    exp = stage_export(
                         self.cache, slot.pages[:n_pages], n_tokens=n,
                         model=self.md.name,
                         prompt_tokens=list(req.prompt_tokens),
                         first_token=req.output_tokens[0], lazy_drain=True,
-                        trace_id=req.trace_id))
+                        trace_id=req.trace_id)
+                    if req.adapter:
+                        # the decode role only reuses same-adapter KV
+                        # (base exports keep the pre-adapter wire meta
+                        # byte-for-byte)
+                        exp.meta["adapter"] = req.adapter
+                    self.kv_exports.put(req.req_id, exp)
             if self.kv_pool is not None:
                 # publish BEFORE _evict_slot: the gather needs the
                 # slot's page ids while they still belong to this
@@ -3320,14 +3445,16 @@ class InferenceEngine:
         into the replica-local pool store (docs/kv-pool.md).  Engine
         thread does only the on-device gather (stage_export); the D2H
         drain runs on the staged export's background copier.  Adapter
-        requests never publish (their KV is adapter-flavored — another
-        replica would serve base-model requests from it)."""
+        requests publish too: their pool_blocks chain is SEEDED with
+        the adapter name (kv_pool.prompt_pool_blocks), so their entries
+        can only ever match same-adapter requests — and the export meta
+        carries the adapter for the fetch-side authority check."""
         from kaito_tpu.engine.kv_pool import PoolEntry, meta_nbytes, pool_key
         from kaito_tpu.engine.pd import stage_export
 
         slot = self.slots[slot_idx]
         req = slot.request
-        if not req.pool_blocks or req.adapter:
+        if not req.pool_blocks:
             return
         ps = self.cfg.page_size
         # whole pages only, and never more pages than hash blocks: the
@@ -3347,6 +3474,11 @@ class InferenceEngine:
                                n_tokens=n_pages * ps, model=self.md.name,
                                prompt_tokens=req.prompt_tokens[:n_pages * ps],
                                first_token=-1, trace_id=req.trace_id)
+        if req.adapter:
+            # fetch-side authority: the importer refuses an entry whose
+            # adapter disagrees with the request's (base entries keep
+            # the pre-adapter wire meta byte-for-byte)
+            exp.meta["adapter"] = req.adapter
         self.kv_pool.put(PoolEntry(key=key, blocks=blocks,
                                    n_tokens=n_pages * ps, n_pages=n_pages,
                                    export=exp, nbytes=meta_nbytes(exp.meta)))

@@ -31,7 +31,7 @@ from typing import Optional
 import numpy as np
 
 from kaito_tpu.engine.pd import plan_chunks, serialize_chunk
-from kaito_tpu.runtime.routing import prefix_blocks
+from kaito_tpu.runtime.routing import adapter_seed, prefix_blocks
 
 # one KV page of page_size tokens covers page_size * CHARS_PER_TOKEN
 # prompt chars — the same heuristic the EPP uses to align its block
@@ -45,12 +45,17 @@ def pool_block_chars(page_size: int) -> int:
     return page_size * CHARS_PER_TOKEN
 
 
-def prompt_pool_blocks(text: str, page_size: int) -> list[int]:
+def prompt_pool_blocks(text: str, page_size: int,
+                       adapter: str = "") -> list[int]:
     """The engine-side publisher's block hashes for a prompt.  MUST
     stay the exact chain the EPP computes (``prefix_blocks`` at
     ``kv_page_size * 4`` chars) — a silent divergence makes the global
-    index useless (pinned by tests/test_kv_pool.py)."""
-    return prefix_blocks(text, pool_block_chars(page_size))
+    index useless (pinned by tests/test_kv_pool.py).  ``adapter`` seeds
+    the chain so KV computed under a LoRA adapter never hash-matches
+    base KV (or another adapter's) for the same text; "" keeps every
+    pre-adapter chain byte-identical."""
+    return prefix_blocks(text, pool_block_chars(page_size),
+                         seed=adapter_seed(adapter))
 
 
 def pool_key(blocks: list[int]) -> str:

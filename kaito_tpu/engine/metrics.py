@@ -426,6 +426,52 @@ class EngineMetrics:
                       "Prefix fetches that fell back to local recompute",
                       r, fn=lambda: engine.counters.get(
                           "kv_pool_fetch_failures_total", 0))
+            if getattr(engine, "adapter_cache", None) is not None:
+                # dynamic multi-LoRA cache (docs/multi-lora.md):
+                # families exist ONLY with the cache enabled — same
+                # byte-identical-off discipline as the KV pool above
+                a_cache = engine.adapter_cache
+                Gauge("kaito:adapter_resident",
+                      "Adapters resident in the HBM slot table", r,
+                      fn=lambda: len(a_cache))
+                Gauge("kaito:adapter_slots_total",
+                      "HBM adapter slot capacity", r,
+                      fn=lambda: a_cache.slots)
+                Gauge("kaito:adapter_loads_total",
+                      "Adapter installs into an HBM slot (boot, "
+                      "hot-load, fault-back-in)", r,
+                      fn=lambda: a_cache.loads_total)
+                Gauge("kaito:adapter_evictions_total",
+                      "Adapters evicted or deleted from the slot table",
+                      r, fn=lambda: a_cache.evictions_total)
+                Gauge("kaito:adapter_hits_total",
+                      "Submissions that found their adapter resident", r,
+                      fn=lambda: a_cache.hits_total)
+                Gauge("kaito:adapter_faults_total",
+                      "Submissions that faulted their adapter back in "
+                      "from the host tier", r,
+                      fn=lambda: a_cache.faults_total)
+                Gauge("kaito:adapter_host_entries",
+                      "Adapters parked in the host-RAM overflow tier", r,
+                      fn=lambda: len(a_cache.host)
+                      if a_cache.host is not None else 0)
+                Gauge("kaito:adapter_host_bytes_used",
+                      "Bytes held by the host-RAM adapter tier", r,
+                      fn=lambda: a_cache.host.used_bytes
+                      if a_cache.host is not None else 0)
+            failures = getattr(engine, "adapter_load_failures", None)
+            if getattr(engine, "adapter_cache", None) is not None \
+                    or failures:
+                # refusal counter, labelled by reason (base_mismatch,
+                # rank_overflow, unreadable, no_targets, capacity).
+                # Present with the cache on, or on the static boot path
+                # once a refusal was actually counted — a no-adapter
+                # exposition stays byte-identical
+                Gauge("kaito:adapter_load_failures_total",
+                      "Adapter loads refused, by reason", r,
+                      labels=("reason",),
+                      fn=lambda: {(k,): float(v)
+                                  for k, v in (failures or {}).items()})
             Gauge("kaito:pd_device_handoffs_total",
                   "Colocated device-to-device KV hand-offs", r,
                   fn=lambda: engine.counters.get(

@@ -76,10 +76,16 @@ class QoSConfig:
     """Parsed, validated tenant-class map."""
 
     def __init__(self, classes: dict[str, TenantClass],
-                 tenants: dict[str, str], default_class: str):
+                 tenants: dict[str, str], default_class: str,
+                 adapters: Optional[dict[str, str]] = None):
         self.classes = classes
         self.tenants = tenants
         self.default_class = default_class
+        # tenant -> LoRA adapter name (docs/multi-lora.md): when a
+        # request's "model" field doesn't select an adapter, the
+        # X-Kaito-Tenant header does — a tenant's traffic rides its
+        # fine-tune without clients changing their model string
+        self.adapters = dict(adapters or {})
 
     def class_of(self, tenant: str,
                  priority: str = "") -> TenantClass:
@@ -93,13 +99,22 @@ class QoSConfig:
     def weight_of(self, tenant: str) -> int:
         return self.class_of(tenant).weight
 
+    def adapter_of(self, tenant: str) -> str:
+        """The adapter a tenant's requests default to ("" = base)."""
+        return self.adapters.get(tenant, "")
+
     def to_dict(self) -> dict:
-        return {
+        out = {
             "classes": {n: dataclasses.asdict(c)
                         for n, c in sorted(self.classes.items())},
             "tenants": dict(sorted(self.tenants.items())),
             "default_class": self.default_class,
         }
+        if self.adapters:
+            # omitted when empty so pre-adapter documents round-trip
+            # byte-identically
+            out["adapters"] = dict(sorted(self.adapters.items()))
+        return out
 
 
 def valid_tenant(tenant: str) -> bool:
@@ -177,6 +192,18 @@ def parse_qos_config(text: str) -> Optional["QoSConfig"]:
         if cls_name not in classes:
             raise ValueError(f"qos tenant {tenant!r} maps to unknown "
                              f"class {cls_name!r}")
+    adapters = doc.get("adapters", {})
+    if not isinstance(adapters, dict):
+        raise ValueError("qos 'adapters' must be a tenant -> adapter map")
+    for tenant, adapter in adapters.items():
+        if not valid_tenant(tenant):
+            raise ValueError(f"qos adapter tenant {tenant!r} is not "
+                             f"label-safe")
+        # adapter names become metric labels and /v1/models ids: hold
+        # them to the same label-safe contract as tenants
+        if not isinstance(adapter, str) or not valid_tenant(adapter):
+            raise ValueError(f"qos adapter name {adapter!r} for tenant "
+                             f"{tenant!r} is not label-safe")
     default_class = doc.get("default_class", "")
     if not default_class:
         if len(classes) == 1:
@@ -187,4 +214,4 @@ def parse_qos_config(text: str) -> Optional["QoSConfig"]:
     if default_class not in classes:
         raise ValueError(f"qos default_class {default_class!r} is not "
                          f"a defined class")
-    return QoSConfig(classes, dict(tenants), default_class)
+    return QoSConfig(classes, dict(tenants), default_class, dict(adapters))

@@ -259,10 +259,22 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         elif self.path == "/v1/models":
             models = [{"id": st.model_name, "object": "model",
                        "owned_by": "kaito-tpu", "root": st.model_name}]
-            for name in st.adapters:
+            # with the dynamic cache, the listing reflects RUNTIME
+            # residency (hot-loads appear, deletes disappear) instead
+            # of the boot-time discovery snapshot
+            snap_fn = getattr(st.engine, "adapter_snapshot", None)
+            snap = snap_fn() if callable(snap_fn) else None
+            if snap is not None:
+                names = sorted({e["name"] for e in snap["resident"]}
+                               | set(snap["host_tier"]))
+            else:
+                names = list(st.adapters)
+            for name in names:
                 models.append({"id": name, "object": "model",
                                "owned_by": "kaito-tpu", "parent": st.model_name})
             self._json(200, {"object": "list", "data": models})
+        elif self.path == "/v1/adapters":
+            self._adapters_get()
         elif self.path.startswith("/debug/trace"):
             self._debug_trace()
         elif self.path.startswith("/debug/timeline"):
@@ -313,6 +325,8 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             rid = self.path[len("/pd/kv/"):]
             gone = self.state.engine.kv_exports.pop(rid) is not None
             self._json(200 if gone else 404, {"released": gone})
+        elif self.path.startswith("/v1/adapters/"):
+            self._adapters_delete(self.path[len("/v1/adapters/"):])
         else:
             self._error(404, f"no route {self.path}")
 
@@ -324,6 +338,8 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             self._completions(chat=True)
         elif self.path == "/pd/prefill":
             self._pd_prefill()
+        elif self.path == "/v1/adapters":
+            self._adapters_post()
         elif self.path == "/start_profile":
             self._profile(start=True)
         elif self.path == "/stop_profile":
@@ -440,6 +456,19 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         prompt = body.get("prompt", "")
         if not isinstance(prompt, str) or not prompt:
             return self._error(400, "'prompt' must be a non-empty string")
+        # adapter-aware prefill: the "model" field selects an adapter
+        # exactly like /v1/completions; the staged meta records it so
+        # the decode role only reuses same-adapter KV
+        adapter = ""
+        model_field = body.get("model") or ""
+        if model_field and model_field not in (st.model_name,
+                                               st.engine.md.name):
+            a_cache = getattr(st.engine, "adapter_cache", None)
+            if model_field in getattr(st.engine, "adapter_index", {}) \
+                    or (a_cache is not None and a_cache.has(model_field)):
+                adapter = model_field
+            else:
+                return self._error(404, f"model {model_field!r} not found")
         tokens = st.engine.tokenizer.encode(prompt)
         params = SamplingParams(
             max_tokens=1,
@@ -451,7 +480,7 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         try:
             req = st.engine.submit(tokens, params,
                                    req_id=f"pd-{uuid.uuid4().hex[:16]}",
-                                   export_kv=True,
+                                   export_kv=True, adapter=adapter,
                                    trace_id=self._rid)
         except ValueError as e:
             return self._error(400, str(e))
@@ -609,10 +638,128 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(data)
 
+    # ---------------- dynamic multi-LoRA admin (docs/multi-lora.md) ---
+
+    def _adapters_get(self):
+        """Resident-adapter snapshot: the admin listing AND the advert
+        the EPP's adapter scraper folds into its affinity index.  403
+        when the dynamic cache is off — with no adapter config the
+        server's observable surface is byte-identical to before (same
+        gating as the KV pool)."""
+        snap_fn = getattr(self.state.engine, "adapter_snapshot", None)
+        snap = snap_fn() if callable(snap_fn) else None
+        if snap is None:
+            return self._error(403, "adapter cache disabled on this pod")
+        self._json(200, snap)
+
+    def _resolve_adapter_source(self, source: str) -> str:
+        """Resolve a POST /v1/adapters source to a local artifact dir.
+        ``path://`` (or a bare path) is operator-local trust; remote
+        pulls — ``hub://<repo-id>`` (huggingface) and ``oras://<ref>``
+        (the registry scheme ModelMirror publishes adapters under) —
+        are allowed only when the source matches an
+        --adapter-source-allowlist prefix ("" = local paths only, the
+        pd_source_allowlist trust model)."""
+        import shutil
+        import subprocess
+        import tempfile
+
+        if source.startswith("path://"):
+            source = source[len("path://"):]
+        if "://" not in source:
+            if not os.path.isdir(source):
+                raise ValueError(
+                    f"adapter path {source!r} is not a directory")
+            return source
+        scheme = source.split("://", 1)[0]
+        if scheme not in ("hub", "oras"):
+            raise ValueError(
+                f"unsupported adapter source scheme {scheme!r} "
+                f"(path://, hub://, oras://)")
+        allow = [p for p in
+                 self.state.cfg.adapter_source_allowlist.split(",") if p]
+        if not any(source.startswith(pref) for pref in allow):
+            raise PermissionError(
+                f"adapter source {source!r} not in "
+                f"--adapter-source-allowlist")
+        dest = tempfile.mkdtemp(prefix="kaito-adapter-")
+        try:
+            if scheme == "hub":
+                from kaito_tpu.runtime.weight_fetch import fetch_from_hub
+
+                fetch_from_hub(source[len("hub://"):], dest)
+            else:
+                subprocess.run(
+                    ["oras", "pull", source[len("oras://"):], "-o", dest],
+                    check=True, capture_output=True, timeout=600)
+        except Exception as e:
+            shutil.rmtree(dest, ignore_errors=True)
+            raise RuntimeError(f"adapter pull from {source} failed: {e}") \
+                from None
+        return dest
+
+    def _adapters_post(self):
+        """Hot-load an adapter into the slot table — no restart, no
+        recompile (the buffers keep their shapes; docs/multi-lora.md)."""
+        st = self.state
+        if getattr(st.engine, "adapter_cache", None) is None:
+            return self._error(403, "adapter cache disabled on this pod")
+        body = self._read_body()
+        if body is None:
+            return
+        from kaito_tpu.engine.qos import valid_tenant
+
+        name = str(body.get("name") or "").strip()
+        source = str(body.get("source") or "").strip()
+        if not name or not source:
+            return self._error(400, "'name' and 'source' are required")
+        if not valid_tenant(name):
+            return self._error(400, "adapter name must be label-safe "
+                                    "(max 64 chars)")
+        try:
+            path = self._resolve_adapter_source(source)
+        except PermissionError as e:
+            return self._error(403, str(e))
+        except ValueError as e:
+            return self._error(400, str(e))
+        except RuntimeError as e:
+            return self._error(502, str(e))
+        from kaito_tpu.engine.adapter_cache import (AdapterBusyError,
+                                                    AdapterLoadError)
+
+        try:
+            slot = st.engine.load_adapter_dynamic(name, path)
+        except AdapterBusyError as e:
+            return self._error(409, str(e))
+        except AdapterLoadError as e:
+            return self._error(422, str(e), "adapter_load_error")
+        except ValueError as e:
+            return self._error(400, str(e))
+        self._json(200, {"loaded": name, "slot": slot})
+
+    def _adapters_delete(self, name: str):
+        """Drop an adapter from both cache tiers.  409 while in-flight
+        requests pin it; 404 when the cache holds no trace of it."""
+        st = self.state
+        if getattr(st.engine, "adapter_cache", None) is None:
+            return self._error(403, "adapter cache disabled on this pod")
+        from kaito_tpu.engine.adapter_cache import AdapterBusyError
+        from urllib.parse import unquote
+
+        name = unquote(name).strip()
+        try:
+            gone = st.engine.delete_adapter(name)
+        except AdapterBusyError as e:
+            return self._error(409, str(e))
+        if not gone:
+            return self._error(404, f"no adapter {name!r}")
+        self._json(200, {"deleted": name})
+
     def _submit_with_pool_fetch(self, url: str, key: str,
                                 tokens: list, params, *,
                                 timeout_s: float = 0.0, tenant: str = "",
-                                priority: str = "", pool_blocks=None):
+                                priority: str = "", adapter: str = "",
+                                pool_blocks=None):
         """Cluster-pool fetch: the EPP picked THIS replica but told us
         (X-Kaito-KV-Fetch headers) that a peer holds the prompt's
         prefix KV.  Pull it over the chunked wire and prefill only the
@@ -676,7 +823,7 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                 tokens, meta, plans, n_prefix, params,
                 req_id=f"cmpl-{uuid.uuid4().hex[:20]}",
                 timeout_s=timeout_s, trace_id=self._rid,
-                tenant=tenant, priority=priority,
+                tenant=tenant, priority=priority, adapter=adapter,
                 pool_blocks=pool_blocks)
         except ValueError as e:
             logger.info("kv_pool fetch submit rejected: %s", e)
@@ -717,7 +864,8 @@ class OpenAIHandler(BaseHTTPRequestHandler):
 
     def _submit_with_transfer(self, kv_src: dict, params,
                               timeout_s: float = 0.0,
-                              tenant: str = "", priority: str = ""):
+                              tenant: str = "", priority: str = "",
+                              adapter: str = ""):
         """Continue decoding from a remote prefill's KV.
 
         Chunked overlapped pull: a handshake fetches the chunk plan,
@@ -727,7 +875,12 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         break-even (pd.should_transfer), the KV move is skipped
         entirely and the prompt prefills locally — cheaper than the
         wire for short prompts.  ``force: true`` in the kv_transfer
-        body pins the transfer path (tests / operator override)."""
+        body pins the transfer path (tests / operator override).
+
+        Adapter requests ride the hand-off only for SAME-adapter
+        reuse: the staged meta records which adapter (if any) the
+        prefill ran under, and a mismatch is refused — prefix KV
+        computed under different deltas would silently skew decode."""
         import urllib.request
 
         from kaito_tpu.engine.pd import ChunkPlan, should_transfer
@@ -764,6 +917,13 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                         self._error(400, "kv_transfer prompt_tokens do not "
                                          "match the staged prefill")
                         return None
+                    if str(staged.meta.get("adapter") or "") != adapter:
+                        src_eng.kv_exports.put(req_id, staged)
+                        self._error(
+                            409, f"kv_transfer adapter mismatch: prefill "
+                                 f"ran {staged.meta.get('adapter') or 'base'!r}, "
+                                 f"request wants {adapter or 'base'!r}")
+                        return None
                     slabs = staged.device_slabs()
                     if slabs is not None:
                         logger.info("kv_transfer %s: colocated source, "
@@ -776,7 +936,7 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                                 req_id=f"cmpl-{uuid.uuid4().hex[:20]}",
                                 timeout_s=timeout_s,
                                 trace_id=self._rid, tenant=tenant,
-                                priority=priority)
+                                priority=priority, adapter=adapter)
                         except ValueError:
                             # a rejected submit must not destroy the
                             # prefill result: re-stage for retry/wire
@@ -824,6 +984,7 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                              name="pd-release").start()
             return eng.submit(prompt_tokens, params,
                               req_id=f"cmpl-{uuid.uuid4().hex[:20]}",
+                              adapter=adapter,
                               timeout_s=timeout_s, trace_id=self._rid)
         try:
             with urllib.request.urlopen(f"{url}/pd/kv/{req_id}/meta",
@@ -834,13 +995,18 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         except Exception as e:
             self._error(502, f"KV meta pull from {url} failed: {e}")
             return None
+        if str(meta.get("adapter") or "") != adapter:
+            self._error(409, f"kv_transfer adapter mismatch: prefill ran "
+                             f"{meta.get('adapter') or 'base'!r}, request "
+                             f"wants {adapter or 'base'!r}")
+            return None
         self._adopt_handoff_trace(meta)
         try:
             req = eng.submit_with_kv_chunked(
                 prompt_tokens, first, meta, plans, params,
                 req_id=f"cmpl-{uuid.uuid4().hex[:20]}",
                 timeout_s=timeout_s, trace_id=self._rid,
-                tenant=tenant, priority=priority)
+                tenant=tenant, priority=priority, adapter=adapter)
         except ValueError as e:
             self._error(400, str(e))
             return None
@@ -1023,34 +1189,50 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         stop_strs = [stop] if isinstance(stop, str) else list(stop or [])
         tokens = st.engine.tokenizer.encode(prompt_text)
         kv_src = body.get("kv_transfer")
-        # cluster-wide KV pool (docs/kv-pool.md): hash the request the
-        # SAME way the EPP does (extract_prompt_text on the body, not
-        # the rendered template) so finished prefixes publish under
-        # exactly the hashes the fleet index computes
-        pool_blocks: list = []
-        if getattr(st.engine, "kv_pool", None) is not None:
-            from kaito_tpu.engine.kv_pool import prompt_pool_blocks
-            from kaito_tpu.runtime.routing import extract_prompt_text
-
-            pool_blocks = prompt_pool_blocks(extract_prompt_text(body),
-                                             st.engine.cfg.page_size)
         # per-request adapter routing: the "model" field selects a
         # discovered adapter, exactly like the reference serves adapters
-        # as models (inference_api.py:417-498)
+        # as models (inference_api.py:417-498).  With the dynamic cache,
+        # host-tier adapters count too — submission faults them back in.
         adapter = ""
         model_field = body.get("model") or ""
         if model_field and model_field not in (st.model_name,
                                                st.engine.md.name):
-            if model_field in getattr(st.engine, "adapter_index", {}):
+            a_cache = getattr(st.engine, "adapter_cache", None)
+            if model_field in getattr(st.engine, "adapter_index", {}) \
+                    or (a_cache is not None and a_cache.has(model_field)):
                 adapter = model_field
             elif getattr(st.engine, "adapters_merged", False) \
                     and model_field in st.adapters:
                 adapter = ""      # TP/PP: adapters merged into base weights
             else:
                 return self._error(404, f"model {model_field!r} not found")
-        if kv_src and adapter:
-            return self._error(400, "per-request adapters are not supported "
-                                    "with KV transfer")
+        if not adapter and tenant and st.qos is not None:
+            # tenant->adapter mapping (docs/multi-lora.md): when the
+            # model field didn't pick one, X-Kaito-Tenant can — the
+            # QoS config pins a tenant's traffic to its fine-tune
+            adapter = st.qos.adapter_of(tenant)
+            if adapter and not (
+                    adapter in getattr(st.engine, "adapter_index", {})
+                    or (getattr(st.engine, "adapter_cache", None)
+                        is not None
+                        and st.engine.adapter_cache.has(adapter))):
+                return self._error(
+                    503, f"tenant adapter {adapter!r} is not loaded on "
+                         f"this replica", "adapter_unavailable")
+        # cluster-wide KV pool (docs/kv-pool.md): hash the request the
+        # SAME way the EPP does (extract_prompt_text on the body, not
+        # the rendered template) so finished prefixes publish under
+        # exactly the hashes the fleet index computes.  The adapter
+        # name seeds the chain — KV computed under adapter deltas must
+        # never hash-match base KV (or another adapter's).
+        pool_blocks: list = []
+        if getattr(st.engine, "kv_pool", None) is not None:
+            from kaito_tpu.engine.kv_pool import prompt_pool_blocks
+            from kaito_tpu.runtime.routing import extract_prompt_text
+
+            pool_blocks = prompt_pool_blocks(extract_prompt_text(body),
+                                             st.engine.cfg.page_size,
+                                             adapter=adapter)
         if kv_src and n_choices > 1:
             return self._error(400, "'n' > 1 is not supported with "
                                     "KV transfer")
@@ -1077,7 +1259,8 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                 req = self._submit_with_transfer(kv_src, params,
                                                  timeout_s=timeout_s,
                                                  tenant=tenant,
-                                                 priority=priority)
+                                                 priority=priority,
+                                                 adapter=adapter)
                 if req is None:
                     return  # error already sent
                 tokens = req.prompt_tokens
@@ -1086,13 +1269,17 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                 fetch_url = self.headers.get("X-Kaito-KV-Fetch", "")
                 fetch_key = self.headers.get("X-Kaito-KV-Fetch-Key", "")
                 if (getattr(st.engine, "kv_pool", None) is not None
-                        and fetch_url and fetch_key and not adapter):
+                        and fetch_url and fetch_key):
                     # the EPP routed here with a fetch hint: a peer
-                    # replica holds this prompt's prefix KV
+                    # replica holds this prompt's prefix KV.  Adapter
+                    # requests participate — their seeded hash chain
+                    # (and the meta authority check) confines the
+                    # fetch to same-adapter entries.
                     req = self._submit_with_pool_fetch(
                         fetch_url, fetch_key, tokens, params,
                         timeout_s=timeout_s, tenant=tenant,
-                        priority=priority, pool_blocks=pool_blocks)
+                        priority=priority, adapter=adapter,
+                        pool_blocks=pool_blocks)
                 if req is None:
                     req = st.engine.submit(
                         tokens, params,
@@ -1464,6 +1651,35 @@ def main(argv=None):
              "Default off (bf16 weights)")
     ap.add_argument("--kaito-config-file", default="")
     ap.add_argument("--kaito-adapters-dir", default="")
+    ap.add_argument("--adapter-slots", type=int,
+                    default=int(os.environ.get("KAITO_ADAPTER_SLOTS", "0")),
+                    help="dynamic multi-LoRA cache: HBM slot-table "
+                         "capacity (docs/multi-lora.md). 0 = off — the "
+                         "static boot-discovery path, /v1/adapters 403 "
+                         "and the /metrics exposition stay byte-"
+                         "identical")
+    ap.add_argument("--adapter-rmax", type=int,
+                    default=int(os.environ.get("KAITO_ADAPTER_RMAX", "16")),
+                    help="max servable adapter rank; higher-rank loads "
+                         "are refused (rank_overflow)")
+    ap.add_argument("--adapter-host-bytes", type=int,
+                    default=int(os.environ.get("KAITO_ADAPTER_HOST_BYTES",
+                                               str(256 << 20))),
+                    help="host-RAM overflow tier for evicted adapters "
+                         "(fault back in without an operator round "
+                         "trip; 0 disables the tier)")
+    ap.add_argument("--adapter-allow-base-mismatch", action="store_true",
+                    default=os.environ.get(
+                        "KAITO_ADAPTER_ALLOW_BASE_MISMATCH", "") == "true",
+                    help="serve adapters whose recorded base model "
+                         "disagrees with the serving model (default: "
+                         "refuse, counted as "
+                         "adapter_load_failures{reason='base_mismatch'})")
+    ap.add_argument("--adapter-source-allowlist",
+                    default=os.environ.get("KAITO_ADAPTER_ALLOWLIST", ""),
+                    help="comma-separated prefixes POST /v1/adapters may "
+                         "pull from (hub://, oras://); '' = local paths "
+                         "only")
     ap.add_argument("--weights-dir",
                     default=os.environ.get("KAITO_WEIGHTS_DIR", ""))
     ap.add_argument("--pd-enabled", action="store_true",
@@ -1564,6 +1780,11 @@ def main(argv=None):
                   if args.kv_cache_dtype not in ("", "auto") else
                   args.dtype or ("bfloat16" if on_tpu else "float32")),
         adapters_dir=args.kaito_adapters_dir,
+        adapter_slots=args.adapter_slots,
+        adapter_rmax=args.adapter_rmax,
+        adapter_host_bytes=args.adapter_host_bytes,
+        adapter_allow_base_mismatch=args.adapter_allow_base_mismatch,
+        adapter_source_allowlist=args.adapter_source_allowlist,
         weights_dir=args.weights_dir,
         quantization=args.quantization,
         pd_enabled=args.pd_enabled,

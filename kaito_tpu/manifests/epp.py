@@ -27,14 +27,18 @@ def build_epp_command(backends: list[str], *,
                       plugins_config: Optional[dict] = None,
                       block_chars: int = 0,
                       draining: Optional[list[str]] = None,
-                      kv_pool: bool = False) -> list[str]:
+                      kv_pool: bool = False,
+                      adapter_affinity: bool = False) -> list[str]:
     """The container command: one ``--backend`` per replica spec
     (``url[=role[/group]]``), the plugin chain inline as JSON, and one
     ``--drain-backend`` per replica the autoscaler is retiring (the
     picker keeps relaying its in-flight work but stops scoring it).
     ``kv_pool`` mirrors the engines' ``kaito-tpu.io/kv-pool``
     annotation: the picker scrapes holder adverts and emits fetch
-    hints only when the replicas actually publish (docs/kv-pool.md)."""
+    hints only when the replicas actually publish (docs/kv-pool.md).
+    ``adapter_affinity`` mirrors ``kaito-tpu.io/adapters`` the same
+    way: resident-adapter adverts are only worth scraping when the
+    replicas run the adapter cache (docs/multi-lora.md)."""
     cmd = ["python", "-m", "kaito_tpu.runtime.epp",
            "--port", str(EPP_PORT)]
     for spec in backends:
@@ -48,6 +52,8 @@ def build_epp_command(backends: list[str], *,
         cmd += ["--block-chars", str(block_chars)]
     if kv_pool:
         cmd += ["--kv-pool"]
+    if adapter_affinity:
+        cmd += ["--adapter-affinity"]
     return cmd
 
 
@@ -57,6 +63,7 @@ def generate_epp_workload(name: str, namespace: str, *,
                           plugins_config: Optional[dict] = None,
                           draining: Optional[list[str]] = None,
                           kv_pool: bool = False,
+                          adapter_affinity: bool = False,
                           image: str = DEFAULT_IMAGE) -> list:
     """Render the ``<name>`` (conventionally ``<cr>-epp``) Deployment +
     Service the InferencePool's extensionRef resolves to."""
@@ -77,7 +84,8 @@ def generate_epp_workload(name: str, namespace: str, *,
                         "image": image,
                         "command": build_epp_command(
                             backends, plugins_config=plugins_config,
-                            draining=draining, kv_pool=kv_pool),
+                            draining=draining, kv_pool=kv_pool,
+                            adapter_affinity=adapter_affinity),
                         "ports": [{"containerPort": EPP_PORT}],
                         "readinessProbe": {
                             "httpGet": {"path": "/router/stats",

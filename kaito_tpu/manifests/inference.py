@@ -11,6 +11,7 @@ service for the JAX coordinator.
 
 from __future__ import annotations
 
+import json
 import shlex
 from typing import Optional
 
@@ -25,6 +26,78 @@ from kaito_tpu.parallel.plan import ParallelPlan
 
 DEFAULT_IMAGE = "ghcr.io/kaito-tpu/engine:latest"
 PORT = 5000
+
+ANNOTATION_ADAPTERS = "kaito-tpu.io/adapters"
+
+# dynamic-adapter source schemes _resolve_adapter_source accepts; a
+# plan-time check here beats a 400 at the first hot-load request
+_ADAPTER_SOURCE_SCHEMES = ("hub://", "oras://")
+
+
+def parse_adapters_annotation(text: str) -> Optional[dict]:
+    """Parse the ``kaito-tpu.io/adapters`` Workspace annotation into
+    the dynamic multi-LoRA cache config (docs/multi-lora.md).  Empty
+    input returns None — the whole adapter plane stays off.  Raises
+    ValueError on a malformed document; the workspace controller calls
+    this at plan time so a bad annotation becomes a PlanFailed
+    condition instead of a crash-looping pod (the qos precedent).
+    jax-free on purpose: the controller imports it.
+
+    .. code-block:: json
+
+        {"slots": 4, "rmax": 16, "host_bytes": 268435456,
+         "allow_base_mismatch": false,
+         "allowlist": ["oras://ghcr.io/acme/"]}
+    """
+    text = (text or "").strip()
+    if not text:
+        return None
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError as e:
+        raise ValueError(f"adapters config is not valid JSON: {e}") \
+            from None
+    if not isinstance(doc, dict):
+        raise ValueError("adapters config must be a JSON object")
+    unknown = set(doc) - {"slots", "rmax", "host_bytes",
+                          "allow_base_mismatch", "allowlist"}
+    if unknown:
+        raise ValueError(f"adapters config has unknown field(s): "
+                         f"{sorted(unknown)}")
+    try:
+        slots = int(doc.get("slots", 0))
+        rmax = int(doc.get("rmax", 16))
+        host_bytes = int(doc.get("host_bytes", 256 << 20))
+    except (TypeError, ValueError) as e:
+        raise ValueError(f"adapters config: {e}") from None
+    if slots < 1:
+        raise ValueError("adapters config needs 'slots' >= 1 (the HBM "
+                         "slot-table capacity)")
+    if rmax < 1:
+        raise ValueError("adapters config: rmax must be >= 1")
+    if host_bytes < 0:
+        raise ValueError("adapters config: host_bytes must be >= 0")
+    allow_mismatch = doc.get("allow_base_mismatch", False)
+    if not isinstance(allow_mismatch, bool):
+        raise ValueError("adapters config: allow_base_mismatch must be "
+                         "a boolean")
+    allowlist = doc.get("allowlist", [])
+    if not isinstance(allowlist, list):
+        raise ValueError("adapters config: allowlist must be a list of "
+                         "source-prefix strings")
+    for pref in allowlist:
+        if not isinstance(pref, str) or not pref.startswith(
+                _ADAPTER_SOURCE_SCHEMES):
+            raise ValueError(
+                f"adapters config: allowlist entry {pref!r} must start "
+                f"with one of {list(_ADAPTER_SOURCE_SCHEMES)}")
+        if "," in pref:
+            raise ValueError(
+                f"adapters config: allowlist entry {pref!r} must not "
+                f"contain ',' (the flag joins entries with commas)")
+    return {"slots": slots, "rmax": rmax, "host_bytes": host_bytes,
+            "allow_base_mismatch": allow_mismatch,
+            "allowlist": [str(p) for p in allowlist]}
 
 
 def coordinator_address(workspace_name: str, namespace: str) -> str:
@@ -99,6 +172,22 @@ def build_engine_command(
         resolved = resolve_speculative_draft(md, spec_draft)
         if resolved:
             args += ["--speculative-draft", resolved]
+    # dynamic multi-LoRA cache (docs/multi-lora.md): the controller
+    # validated the document at plan time; rendering turns it into the
+    # server's slot-table flags.  The EPP deployment mirrors the same
+    # annotation as --adapter-affinity so residency adverts are scraped
+    # exactly when the replicas serve them.
+    lora = parse_adapters_annotation(
+        ws.metadata.annotations.get(ANNOTATION_ADAPTERS, ""))
+    if lora:
+        args += ["--adapter-slots", str(lora["slots"]),
+                 "--adapter-rmax", str(lora["rmax"]),
+                 "--adapter-host-bytes", str(lora["host_bytes"])]
+        if lora["allow_base_mismatch"]:
+            args += ["--adapter-allow-base-mismatch"]
+        if lora["allowlist"]:
+            args += ["--adapter-source-allowlist",
+                     ",".join(lora["allowlist"])]
     if config_file:
         args += ["--kaito-config-file", config_file]
     if adapters_dir:

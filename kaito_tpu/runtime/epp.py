@@ -39,7 +39,8 @@ from kaito_tpu.engine.metrics import Counter, Gauge, Registry
 from kaito_tpu.engine.qos import priority_rank
 from kaito_tpu.runtime.routing import (Backend, PrefixAffinityIndex,
                                        RoutingCore, _BackendPoller, _MASK64,
-                                       _fnv1a, extract_prompt_text,
+                                       _fnv1a, adapter_seed,
+                                       extract_prompt_text,
                                        make_routing_server, prefix_blocks)
 
 logger = logging.getLogger(__name__)
@@ -59,6 +60,17 @@ AFFINITY_WEIGHT = 3.0
 # hit on the picked replica beats a cross-replica fetch) but above the
 # load terms, so a healthy holder wins ties against equally-loaded peers
 POOL_WEIGHT = 2.5
+
+# adapter-residency weight (docs/multi-lora.md): below POOL_WEIGHT —
+# faulting an adapter in from a replica's host tier (or hot-loading it)
+# is cheaper than re-prefilling a long prefix — but above the load
+# terms, so adapter-tagged traffic concentrates on replicas already
+# serving that adapter instead of spreading slot-table churn fleet-wide
+ADAPTER_WEIGHT = 2.0
+
+# cap on adapter names folded in per advert — a hand-rolled replica
+# can't balloon the index (real slot tables hold a few dozen at most)
+_MAX_ADAPTERS_PER_ADVERT = 1024
 
 
 class KVPoolIndex:
@@ -172,6 +184,105 @@ class KVPoolScraper(_BackendPoller):
             self.picker.pool_index.update(b.url, advert)
 
 
+class AdapterIndex:
+    """Fleet-wide adapter→holder lookup (docs/multi-lora.md).
+
+    Built from the ``/v1/adapters`` snapshots each replica serves:
+    per replica, which adapters sit in its HBM slot table (score 1.0 —
+    requests dispatch against them immediately) and which are parked in
+    its host tier (score 0.5 — a fault-back-in away).  The union of all
+    advertised names doubles as the picker's answer to "is this
+    request's ``model`` field an adapter?" — the EPP has no catalog of
+    its own, so only names the fleet actually serves get the
+    adapter-seeded hash chain (a not-yet-scraped adapter degrades to
+    unseeded blocks: no affinity signal, no pool match, never a wrong
+    route)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # url -> {adapter name -> residency score (1.0 HBM, 0.5 host)}
+        self._by_url: dict[str, dict[str, float]] = {}
+        self._names: set[str] = set()           # fleet-wide union
+        self.updates = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._names)
+
+    def update(self, url: str, snap: Optional[dict]) -> None:
+        """Replace one replica's advert (None/disabled = forget it —
+        a restart or scrape failure must not leave stale residency
+        steering adapter traffic at a replica that dropped it)."""
+        with self._lock:
+            if isinstance(snap, dict) and snap.get("enabled"):
+                scores: dict[str, float] = {}
+                for e in (snap.get("resident") or
+                          [])[:_MAX_ADAPTERS_PER_ADVERT]:
+                    name = str((e or {}).get("name") or "")
+                    if name:
+                        scores[name] = 1.0
+                for name in (snap.get("host_tier") or
+                             [])[:_MAX_ADAPTERS_PER_ADVERT]:
+                    scores.setdefault(str(name), 0.5)
+                if scores:
+                    self._by_url[url] = scores
+                else:
+                    self._by_url.pop(url, None)
+            else:
+                self._by_url.pop(url, None)
+            self._names = set().union(*self._by_url.values()) \
+                if self._by_url else set()
+            self.updates += 1
+
+    def drop(self, url: str) -> None:
+        self.update(url, None)
+
+    def known(self, name: str) -> bool:
+        with self._lock:
+            return name in self._names
+
+    def residency(self, name: str) -> dict[str, float]:
+        """url -> residency score for every replica serving ``name``."""
+        with self._lock:
+            return {url: scores[name]
+                    for url, scores in self._by_url.items()
+                    if name in scores}
+
+
+class AdapterScraper(_BackendPoller):
+    """Background ``/v1/adapters`` snapshot scrape per backend (the
+    same poller family as the KV-pool advert scrape).  A 403 (cache
+    disabled), connect failure, or garbage body clears that replica's
+    residency rows."""
+
+    def __init__(self, picker: "EndpointPicker", interval_s: float = 2.0,
+                 timeout_s: float = 2.0):
+        super().__init__("epp-adapter-scraper", interval_s)
+        self.picker = picker
+        self.timeout_s = timeout_s
+
+    def targets(self) -> Iterable[Backend]:
+        return [b for b in self.picker.backends if b.alive]
+
+    def poll_one(self, b: Backend) -> None:
+        snap = None
+        try:
+            conn = http.client.HTTPConnection(b.host, b.port,
+                                              timeout=self.timeout_s)
+            try:
+                conn.request("GET", "/v1/adapters")
+                resp = conn.getresponse()
+                if resp.status == 200:
+                    snap = json.loads(resp.read().decode("utf-8",
+                                                         "replace"))
+            finally:
+                conn.close()
+        except (ConnectionError, OSError, ValueError):
+            snap = None
+        if self.picker.adapter_index is not None:
+            self.picker.adapter_index.update(b.url, snap)
+
+
 def default_epp_plugins_config() -> dict:
     """Standalone (InferenceSet) chain: no roles to filter, so the
     pd-filter is a no-op and affinity + load do the work."""
@@ -194,7 +305,8 @@ class RequestCtx:
     """Everything scoring needs, parsed once per request."""
 
     __slots__ = ("blocks", "matched", "kv_source", "want_role", "steered",
-                 "tenant", "priority", "pool_match")
+                 "tenant", "priority", "pool_match", "adapter",
+                 "adapter_residency")
 
     def __init__(self):
         self.blocks: list[int] = []            # prompt prefix block hashes
@@ -206,6 +318,9 @@ class RequestCtx:
         self.priority: str = ""                # X-Kaito-Priority class name
         # cluster KV pool: url -> (entry key, matched pages, entry tokens)
         self.pool_match: dict[str, tuple] = {}
+        self.adapter: str = ""                 # resolved LoRA adapter name
+        # url -> residency score (1.0 HBM slot, 0.5 host tier)
+        self.adapter_residency: dict[str, float] = {}
 
 
 def _extract_prompt(body: Optional[bytes]) -> str:
@@ -247,7 +362,8 @@ class EndpointPicker(RoutingCore):
                  plugins_config: Optional[dict] = None,
                  registry: Optional[Registry] = None,
                  draining: Optional[Iterable[str]] = None,
-                 kv_pool: bool = False):
+                 kv_pool: bool = False,
+                 adapter_affinity: bool = False):
         # empty pools are legal here: a scaled-to-zero InferenceSet
         # keeps its EPP front alive so arrivals surface as
         # kaito:router_requests_received_total (the wake signal) while
@@ -268,6 +384,14 @@ class EndpointPicker(RoutingCore):
         if kv_pool and not any(t == "kv-pool-scorer"
                                for t, _ in self.plugins):
             self.plugins.append(("kv-pool-scorer", POOL_WEIGHT))
+        # multi-LoRA adapter affinity (docs/multi-lora.md): same
+        # flag-gated discipline — with it off, no index, no scorer, no
+        # metric families, byte-identical scoring and exposition
+        self.adapter_index = AdapterIndex() if adapter_affinity else None
+        if adapter_affinity and not any(t == "adapter-affinity-scorer"
+                                        for t, _ in self.plugins):
+            self.plugins.append(("adapter-affinity-scorer",
+                                 ADAPTER_WEIGHT))
         r = self.registry
         self.m_picks = Counter(
             "kaito:epp_picks_total",
@@ -308,6 +432,18 @@ class EndpointPicker(RoutingCore):
                   "Distinct (block_chars, block hash) rows in the "
                   "cluster prefix->holder index", r,
                   fn=lambda: float(len(self.pool_index)))
+        if adapter_affinity:
+            self.m_adapter_hits = Counter(
+                "kaito:epp_adapter_affinity_hits_total",
+                "Adapter requests routed to a replica already holding "
+                "the adapter (HBM slot or host tier)", r)
+            self.m_adapter_misses = Counter(
+                "kaito:epp_adapter_affinity_misses_total",
+                "Adapter requests with no resident replica (target must "
+                "hot-load before serving)", r)
+            Gauge("kaito:epp_adapter_index_size",
+                  "Distinct adapter names advertised by the fleet", r,
+                  fn=lambda: float(len(self.adapter_index)))
 
     # -- affinity block size ----------------------------------------------
     @property
@@ -343,15 +479,10 @@ class EndpointPicker(RoutingCore):
         if kv_source:
             ctx.kv_source = kv_source
             ctx.want_role = ctx.want_role or "decode"
-        prompt = _extract_prompt(body)
-        if prompt:
-            ctx.blocks = prefix_blocks(prompt, self.block_chars)
-            if ctx.blocks:
-                ctx.matched = self.index.match(ctx.blocks)
-                if self.pool_index is not None:
-                    ctx.pool_match = self.pool_index.match(
-                        ctx.blocks, self.block_chars)
-        if not ctx.tenant or not ctx.priority:
+        if headers is not None:
+            ctx.adapter = (headers.get("X-Kaito-Adapter") or "").strip()
+        if not ctx.tenant or not ctx.priority or (
+                not ctx.adapter and self.adapter_index is not None):
             try:
                 obj = json.loads(body) if body else {}
             except (ValueError, UnicodeDecodeError):
@@ -359,6 +490,28 @@ class EndpointPicker(RoutingCore):
             if isinstance(obj, dict):
                 ctx.tenant = ctx.tenant or str(obj.get("tenant") or "")
                 ctx.priority = ctx.priority or str(obj.get("priority") or "")
+                # the picker only trusts a "model" field as an adapter
+                # selector when a scraped advert has named it: a scrape
+                # race degrades to unseeded blocks (no affinity, no
+                # pool match) — never a wrong route or a poisoned seed
+                if not ctx.adapter and self.adapter_index is not None:
+                    model = str(obj.get("model") or "")
+                    if model and self.adapter_index.known(model):
+                        ctx.adapter = model
+        if ctx.adapter and self.adapter_index is not None:
+            ctx.adapter_residency = self.adapter_index.residency(ctx.adapter)
+        prompt = _extract_prompt(body)
+        if prompt:
+            # the adapter name seeds the hash chain exactly like the
+            # engine's pool/prefix publishing does, so adapter traffic
+            # never affinity-matches (or pool-fetches) base KV
+            ctx.blocks = prefix_blocks(prompt, self.block_chars,
+                                       seed=adapter_seed(ctx.adapter))
+            if ctx.blocks:
+                ctx.matched = self.index.match(ctx.blocks)
+                if self.pool_index is not None:
+                    ctx.pool_match = self.pool_index.match(
+                        ctx.blocks, self.block_chars)
         return ctx
 
     def _filter_role(self, ctx: RequestCtx,
@@ -404,6 +557,15 @@ class EndpointPicker(RoutingCore):
                     if info is not None and ctx.blocks:
                         total += weight * min(1.0,
                                               info[1] / len(ctx.blocks))
+            elif ptype == "adapter-affinity-scorer":
+                # LoRA residency locality: a replica with the adapter in
+                # an HBM slot scores 1.0 (instant dispatch), host tier
+                # 0.5 (one fault-in away), elsewhere 0 (full hot-load).
+                # Saturated/tripped replicas earn nothing, mirroring the
+                # other affinity scorers.
+                if ctx.adapter and ctx.adapter_residency \
+                        and not b.saturated and b.state == "closed":
+                    total += weight * ctx.adapter_residency.get(b.url, 0.0)
             elif ptype == "queue-depth-scorer":
                 total += weight / (1.0 + b.load.waiting)
             elif ptype == "kv-load-scorer":
@@ -505,6 +667,11 @@ class EndpointPicker(RoutingCore):
                 self.m_pool_route.inc()
             elif self.request_headers(ctx, backend):
                 self.m_pool_fetch.inc()
+        if ctx.adapter and self.adapter_index is not None:
+            if ctx.adapter_residency.get(backend.url, 0.0) > 0:
+                self.m_adapter_hits.inc()
+            else:
+                self.m_adapter_misses.inc()
         if ctx.blocks:
             if ctx.matched.get(backend.url, 0) > 0:
                 self.m_affinity_hits.inc()
@@ -560,6 +727,14 @@ def main(argv=None):
     ap.add_argument("--kv-pool-scrape-interval-s", type=float, default=2.0,
                     help="per-backend /debug/kv_pool advert scrape "
                          "cadence (0 = off)")
+    ap.add_argument("--adapter-affinity", action="store_true",
+                    help="enable the multi-LoRA adapter-affinity index: "
+                         "scrape /v1/adapters adverts, seed prefix "
+                         "hashes per adapter, score resident replicas "
+                         "(docs/multi-lora.md)")
+    ap.add_argument("--adapter-scrape-interval-s", type=float, default=2.0,
+                    help="per-backend /v1/adapters advert scrape "
+                         "cadence (0 = off)")
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
@@ -577,7 +752,8 @@ def main(argv=None):
         index_capacity=args.index_capacity,
         plugins_config=plugins_config,
         draining=args.drain_backend,
-        kv_pool=args.kv_pool)
+        kv_pool=args.kv_pool,
+        adapter_affinity=args.adapter_affinity)
     srv = make_routing_server(picker, args.host, args.port,
                               probe_interval_s=args.health_probe_interval_s,
                               scrape_interval_s=args.scrape_interval_s)
@@ -585,6 +761,10 @@ def main(argv=None):
         pool_scraper = KVPoolScraper(picker, args.kv_pool_scrape_interval_s)
         pool_scraper.start()
         srv.pool_scraper = pool_scraper      # type: ignore[attr-defined]
+    if args.adapter_affinity and args.adapter_scrape_interval_s > 0:
+        a_scraper = AdapterScraper(picker, args.adapter_scrape_interval_s)
+        a_scraper.start()
+        srv.adapter_scraper = a_scraper      # type: ignore[attr-defined]
 
     def _term(signum, frame):
         logger.info("SIGTERM: draining %d in-flight request(s)",

@@ -83,6 +83,8 @@ _ENGINE_GAUGES = {
     "kaito:process_resident_memory_bytes": ("rss_bytes", "sum"),
     "kaito:host_kv_entries": ("host_kv_entries", "sum"),
     "kaito:host_kv_bytes_used": ("host_kv_bytes", "sum"),
+    "kaito:adapter_resident": ("adapter_resident", "sum"),
+    "kaito:adapter_slots_total": ("adapter_slots_total", "sum"),
 }
 # cumulative counters -> per-replica delta rates at fold time
 _ENGINE_COUNTERS = {
@@ -96,6 +98,9 @@ _ENGINE_COUNTERS = {
     "kaito:host_kv_hits_total": "host_kv_hits_total",
     "kaito:host_kv_misses_total": "host_kv_misses_total",
     "kaito:host_kv_evictions_total": "host_kv_evictions_total",
+    "kaito:adapter_loads_total": "adapter_loads_total",
+    "kaito:adapter_evictions_total": "adapter_evictions_total",
+    "kaito:adapter_hits_total": "adapter_hits_total",
 }
 # EPP / router front series (arrival side of the same CR).  The
 # received counter keeps ticking even with ZERO backends — it is the
@@ -625,6 +630,8 @@ class FleetTelemetry:
                 "spec_proposed_total", "spec_accepted_total",
                 "host_kv_hits_total", "host_kv_misses_total",
                 "host_kv_evictions_total",
+                "adapter_loads_total", "adapter_evictions_total",
+                "adapter_hits_total",
                 "forwarded_total", "received_total"]
         # per-tenant counters carry the tenant in the key itself
         # ("tenant_shed_total:acme"), so rate whatever both samples have
@@ -783,6 +790,14 @@ class FleetTelemetry:
             "host_kv_evictions_rate": rate("host_kv_evictions_rate"),
             "host_kv_hit_rate": (hkv_hit / (hkv_hit + hkv_miss)
                                  if hkv_hit + hkv_miss > 0 else 0.0),
+            # multi-LoRA adapter plane (docs/multi-lora.md): residency
+            # vs capacity (is the slot table sized right?), hot-load +
+            # eviction churn, and per-request adapter traffic
+            "adapter_resident": fold("adapter_resident", "sum"),
+            "adapter_slots_total": fold("adapter_slots_total", "sum"),
+            "adapter_loads_rate": rate("adapter_loads_rate"),
+            "adapter_evictions_rate": rate("adapter_evictions_rate"),
+            "adapter_hits_rate": rate("adapter_hits_rate"),
         }
         if epps:
             agg["arrival_rate"] = sum(
@@ -994,6 +1009,23 @@ class FleetTelemetry:
         Gauge("kaito:fleet_host_kv_hit_rate",
               "Fleet host KV offload hit ratio (rate-weighted)", r,
               labels=("kind", "name"), fn=family("host_kv_hit_rate"))
+        Gauge("kaito:fleet_adapter_resident",
+              "LoRA adapters resident in HBM slots, fleet-wide", r,
+              labels=("kind", "name"), fn=family("adapter_resident"))
+        Gauge("kaito:fleet_adapter_slots_total",
+              "LoRA HBM slot capacity summed over the fleet", r,
+              labels=("kind", "name"), fn=family("adapter_slots_total"))
+        Gauge("kaito:fleet_adapter_loads_per_s",
+              "Fleet adapter hot-load rate (install + host fault-in)", r,
+              labels=("kind", "name"), fn=family("adapter_loads_rate"))
+        Gauge("kaito:fleet_adapter_evictions_per_s",
+              "Fleet adapter slot-eviction rate (churn: slots too "
+              "few for the working set)", r,
+              labels=("kind", "name"), fn=family("adapter_evictions_rate"))
+        Gauge("kaito:fleet_adapter_hits_per_s",
+              "Fleet rate of requests served by an already-resident "
+              "adapter", r,
+              labels=("kind", "name"), fn=family("adapter_hits_rate"))
 
         def tenant_family(prefix):
             def _fn():

@@ -206,17 +206,29 @@ def _fnv1a(data: bytes, seed: int) -> int:
     return h
 
 
-def prefix_blocks(text: str, block_chars: int) -> list[int]:
+def adapter_seed(adapter: str) -> int:
+    """Chain seed folding a LoRA adapter name into the block hashes
+    (docs/multi-lora.md): KV computed under adapter deltas must never
+    hash-match base KV (or another adapter's) for the same text, so
+    both hashing sides — the engine's pool publisher and the EPP —
+    seed the chain with the adapter identity.  "" (base) keeps seed 0:
+    every pre-adapter chain is byte-identical."""
+    return _fnv1a(adapter.encode("utf-8", "replace"), 0) if adapter else 0
+
+
+def prefix_blocks(text: str, block_chars: int, seed: int = 0) -> list[int]:
     """Chained block hashes of a prompt prefix: block i's hash folds in
     block i-1's, exactly the chaining the engine's radix tree uses for
     token pages (equal blocks at different depths hash differently).
     Trailing partial blocks are dropped — the engine can only reuse
-    whole KV pages, so a partial block can never be a cache hit."""
+    whole KV pages, so a partial block can never be a cache hit.
+    ``seed`` (default 0 = unchanged chains) namespaces the whole chain,
+    e.g. per LoRA adapter via ``adapter_seed``."""
     if block_chars <= 0:
         return []
     data = text.encode("utf-8", "replace")
     out: list[int] = []
-    parent = 0
+    parent = seed & _MASK64
     for i in range(len(data) // block_chars):
         parent = _fnv1a(data[i * block_chars:(i + 1) * block_chars], parent)
         out.append(parent)
