@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: all native unit-test unit-test-fast unit-test-slow engine-test rag-test chaos kvq wquant kvpool lora obs slo fleet autoscale spec qos bench serve manager epp clean
+.PHONY: all native unit-test unit-test-fast unit-test-slow engine-test rag-test chaos kvq wquant kvpool lora obs slo fleet autoscale spec qos asyncloop bench serve manager epp clean
 
 all: native
 
@@ -104,6 +104,16 @@ qos:
 # controller, real-checkpoint greedy equivalence, plumbing
 spec:
 	$(PYTHON) -m pytest tests/test_speculative.py tests/test_spec_draft.py -q
+
+# zero-bubble decode loop (docs/decode-loop.md): the dedicated async
+# suite, then the fused-decode engine tier once more with
+# KAITO_ASYNC_DISPATCH=1 (engines built with the default config resolve
+# the env gate) so the gated pipeline path can't rot behind its
+# off-by-default flag
+asyncloop:
+	$(PYTHON) -m pytest tests/test_async_dispatch.py -q
+	KAITO_ASYNC_DISPATCH=1 $(PYTHON) -m pytest \
+	  tests/test_async_dispatch.py tests/test_decode_run_ahead.py -q
 
 bench:
 	$(PYTHON) bench.py

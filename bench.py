@@ -598,6 +598,7 @@ def _bench_serving_once(model_name: str, on_tpu: bool, quant: str,
                         spec_temp: float = 0.0) -> dict:
     from kaito_tpu.engine.config import EngineConfig
     from kaito_tpu.engine.engine import InferenceEngine, SamplingParams
+    from kaito_tpu.utils.tracing import decode_gap_summary
 
     if on_tpu:
         prompt_len, out_toks = 128, 256
@@ -723,11 +724,18 @@ def _bench_serving_once(model_name: str, on_tpu: bool, quant: str,
         eng.stop()
         for t in threads:
             t.join(timeout=10)
+    # decode-loop bubble position (docs/decode-loop.md): how much of the
+    # decode wall clock the device spent waiting on host dispatch.
+    # Schema-stable — both columns read 0.0 when the async loop is off
+    # (no timeline record carries the dispatch_gap span).
+    idle_pct, gap_ms = decode_gap_summary(eng.timeline.records())
     out = {
         "server_tok_s": round(tok_s, 1),
         "server_tpm": round(tok_s * 60.0),
         "server_batch": max_seqs,
         "server_out_toks": out_toks,
+        "device_idle_pct": round(idle_pct, 2),
+        "dispatch_gap_ms": round(gap_ms, 3),
     }
     # every throughput row carries its roofline position (VERDICT r5
     # weak #1): how close this number is to the chip's compute and
@@ -919,18 +927,34 @@ def phase_raw(args):
         log(f"[{impl}] decode loop compile+warmup: {time.monotonic() - t0:.1f}s")
 
         # timed runs (cache keeps advancing; positions restart per run
-        # which re-measures the same window — steady state)
+        # which re-measures the same window — steady state).  Between
+        # runs the host gap (ready -> next dispatch) is the raw-path
+        # analogue of the engine loop's dispatch_gap span: the bubble
+        # the device sits idle while the host turns the loop around.
         best = 0.0
+        run_wall = 0.0
+        host_gaps = []
+        t_ready = None
         for r in range(args.repeats):
             t0 = time.monotonic()
+            if t_ready is not None:
+                host_gaps.append(t0 - t_ready)
             cache, out = decode_jit(params, cache, first, page_tables)
             jax.block_until_ready(out)
-            dt = time.monotonic() - t0
+            t_ready = time.monotonic()
+            dt = t_ready - t0
+            run_wall += dt
             tps = batch * steps / dt
             log(f"[{impl}] run {r}: {dt * 1e3:.1f} ms -> {tps:.0f} tok/s")
             best = max(best, tps)
 
-        return best
+        if host_gaps and run_wall > 0.0:
+            gap_total = sum(host_gaps)
+            gap_stats = (100.0 * gap_total / (run_wall + gap_total),
+                         1e3 * gap_total / len(host_gaps))
+        else:       # single repeat: no inter-dispatch window to measure
+            gap_stats = (0.0, 0.0)
+        return best, gap_stats
 
     def measure_ttft(model):
         """Steady-state single-request TTFT: warm batch-1 prefill +
@@ -957,10 +981,11 @@ def phase_raw(args):
         return sorted(ttfts)[len(ttfts) // 2] * 1e3
 
     best = ttft_ms = None
+    gap_stats = (0.0, 0.0)
     batch = batch_ladder[0]
     for i, batch in enumerate(batch_ladder):
         try:
-            best = run_path(attn_impl, model, batch)
+            best, gap_stats = run_path(attn_impl, model, batch)
             break
         except Exception as e:
             oom = "RESOURCE_EXHAUSTED" in str(e)
@@ -986,7 +1011,7 @@ def phase_raw(args):
                 # the JAX path gathers/expands full K/V and needs more
                 # HBM than the kernel path: run it at the smallest rung
                 model = TransformerLM(arch, dtype=dtype, attn_impl="jax")
-                best = run_path("jax", model, batch_ladder[-1])
+                best, gap_stats = run_path("jax", model, batch_ladder[-1])
                 batch = batch_ladder[-1]
             except Exception as e2:
                 log(f"jax fallback failed too ({type(e2).__name__}: {e2})")
@@ -1017,6 +1042,8 @@ def phase_raw(args):
         "attn_impl": attn_impl,
         "kv_dtype": ("int8" if args.kv_dtype == "int8"
                      else ("bfloat16" if on_tpu else "float32")),
+        "device_idle_pct": round(gap_stats[0], 2),
+        "dispatch_gap_ms": round(gap_stats[1], 3),
     }
     result.update(_roofline_metrics(
         arch, best, batch, total_len, quant=args.quant,

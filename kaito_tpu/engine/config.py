@@ -61,6 +61,13 @@ class EngineConfig:
     # decode_run_ahead so admissions and prefill chunks keep a bounded
     # latency; 0 restores the round-2 collapse-to-single-step behavior
     fused_under_load: int = 4
+    # zero-bubble decode loop (docs/decode-loop.md): device-resident
+    # loop state plus a two-deep dispatch pipeline that overlaps host
+    # postprocess (stop replay, streaming, scheduling) with device
+    # compute.  None = follow KAITO_ASYNC_DISPATCH (off when unset);
+    # True/False force it.  Off keeps the synchronous loop
+    # byte-identical to before (no new metric families).
+    async_dispatch: Optional[bool] = None
     # n-gram (prompt-lookup) speculative decoding: propose up to N
     # continuation tokens by matching the trailing n-gram against the
     # sequence's own context, verify them in ONE windowed dispatch, and

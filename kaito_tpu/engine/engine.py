@@ -214,6 +214,20 @@ class _Slot:
 class InferenceEngine:
     """Synchronous engine core; the HTTP server drives it via a thread."""
 
+    # loop-state fields the async decode path keeps device-resident
+    # (docs/decode-loop.md).  "left" is the fused-scan budget countdown
+    # (host mirror: _remaining); the rest mirror the same-named numpy
+    # arrays.  DEVICE_ADVANCED fields are the ones the scan itself
+    # advances — their host mirrors lag any in-flight window, so a
+    # dirty mark on them forces a pipeline drain before re-upload
+    # (uploading a stale mirror would roll the device state back).
+    # page_tables / slot_adapters are host-only-written and safe to
+    # re-upload while a window is in flight.
+    _STATE_FIELDS = ("last_tokens", "positions", "active", "page_tables",
+                     "slot_adapters", "left")
+    _DEVICE_ADVANCED = frozenset(("last_tokens", "positions", "active",
+                                  "left"))
+
     def __init__(
         self,
         cfg: EngineConfig,
@@ -601,6 +615,46 @@ class InferenceEngine:
             ra = 16 if jax.default_backend() == "tpu" else 1
         self.run_ahead = max(1, int(ra))
         self._decode_multi_fns: dict[int, object] = {}
+
+        # zero-bubble decode loop (docs/decode-loop.md): device-resident
+        # loop state + a two-deep dispatch pipeline.  Off by default —
+        # the synchronous loop (and the /metrics exposition) stays
+        # byte-identical; None follows KAITO_ASYNC_DISPATCH.  PP drives
+        # decode through its own executor and multi-process engines run
+        # lockstep off the step broadcast, so both keep the sync loop.
+        ad = cfg.async_dispatch if getattr(cfg, "async_dispatch", None) \
+            is not None else (os.environ.get("KAITO_ASYNC_DISPATCH", "")
+                              in ("1", "true"))
+        self.async_dispatch = (bool(ad) and self.pp_exec is None
+                               and jax.process_count() == 1)
+        self.dispatch_gap_hist = None
+        if self.async_dispatch:
+            self.counters["h2d_uploads_total"] = 0
+            self.dispatch_gap_hist = Histogram(
+                "kaito:engine_dispatch_gap_seconds",
+                "Host-side gap between decode dispatches (device idle "
+                "between windows; ~0 when the pipeline is primed)", None,
+                buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                         0.01, 0.025, 0.05, 0.1, 0.25))
+            logger.info("async decode dispatch enabled (two-deep "
+                        "pipeline, device-resident loop state)")
+        # device-resident state mirrors: host numpy stays authoritative
+        # at admission/eviction/preempt boundaries; the async loop
+        # uploads only fields marked dirty since the last dispatch
+        self._dev_state: dict[str, object] = {}
+        self._state_dirty: set[str] = set(self._STATE_FIELDS)
+        self._decode_multi_state_fns: dict[int, object] = {}
+        self._inflight: Optional[tuple] = None  # (K, toks, acts, lps)
+        self._last_ready_t = 0.0
+        self._gap_last = 0.0
+        # fused-dispatch argument caches (built for both loops): the
+        # stop matrix is epoch-keyed (stop sets are per-request
+        # immutable, so batch membership is the only invalidation) and
+        # the remaining-budget array is an incrementally maintained
+        # mirror of slot.remaining — no per-dispatch Python loop
+        self._remaining = np.zeros((S,), np.int32)
+        self._batch_epoch = 0
+        self._stop_cache: tuple = (-1, None)
 
         from kaito_tpu.engine.pd import KVExportRegistry, TransferCostModel
 
@@ -1034,14 +1088,19 @@ class InferenceEngine:
 
         return decode_step
 
-    def _build_decode_multi_fn(self, K: int):
+    def _build_decode_multi_fn(self, K: int, with_state: bool = False):
         """K fused decode steps in ONE dispatch (lax.scan) with
         on-device sampling, stop-token detection and per-slot budget
         tracking.  A slot that emits a stop token (or exhausts its
         budget) goes inactive inside the scan, so no KV is ever written
         past its last real token — the host replays the returned
         (tokens, active) trace through the exact same _emit path as the
-        single-step loop."""
+        single-step loop.
+
+        with_state=True additionally returns the final scan carry
+        (next_tokens, positions, active, steps_left) so the async loop
+        can feed window N+1 straight from device-resident state without
+        ever materializing the host mirrors (docs/decode-loop.md)."""
         model = self.model
 
         @partial(jax.jit, donate_argnums=(1, 2, 3))
@@ -1080,8 +1139,11 @@ class InferenceEngine:
 
             carry = (cache, sampling, counts, tokens, positions, active,
                      steps_left)
-            (cache, sampling, counts, *_), (toks, acts, lps) = jax.lax.scan(
-                body, carry, None, length=K)
+            (cache, sampling, counts, nxt, pos, act, left), \
+                (toks, acts, lps) = jax.lax.scan(body, carry, None, length=K)
+            if with_state:
+                return (cache, sampling, counts, toks, acts, lps,
+                        (nxt, pos, act, left))
             return cache, sampling, counts, toks, acts, lps
 
         return decode_multi
@@ -1767,6 +1829,9 @@ class InferenceEngine:
         slot.remaining = 0
         self.slot_adapters[slot_idx] = 0
         self.active[slot_idx] = False
+        self._remaining[slot_idx] = 0
+        self._batch_epoch += 1
+        self._mark_state_dirty("active", "slot_adapters", "left")
 
     def _fail_request(self, req: Request, status: int = 500,
                       etype: str = "internal_error",
@@ -1880,6 +1945,13 @@ class InferenceEngine:
                 self._fail_request(req)
 
     def _fail_all(self):
+        # an engine-fatal step may have died with a window in flight;
+        # its readback is unreferenceable and the device-resident state
+        # may alias donated-into-failure buffers — reset the pipeline
+        # and force a full re-upload from the (authoritative) host side
+        self._inflight = None
+        self._dev_state.clear()
+        self._mark_state_dirty()
         self._fail_active_slots()
         while True:
             req = self._pop_waiting()
@@ -1957,8 +2029,15 @@ class InferenceEngine:
         if did:
             wall = time.monotonic() - t0
             self.step_hist.observe(wall)
+            extra = {}
+            if self.async_dispatch:
+                # per-dispatch gap span (docs/decode-loop.md): host-side
+                # idle between the previous window's readback and this
+                # step's dispatch; ~0 whenever the pipeline was primed
+                extra["dispatch_gap"] = round(self._gap_last, 6)
+                self._gap_last = 0.0
             self.timeline.add(
-                t0, wall,
+                t0, wall, **extra,
                 running=self.num_running,
                 waiting=self._waiting_count,
                 prefill_steps=c["prefill_steps_total"] - before[0],
@@ -1980,6 +2059,8 @@ class InferenceEngine:
         new prompts stream in.
         """
         FAILPOINTS.fire("engine.step")
+        if self.async_dispatch:
+            return self._step_async()
         did0 = False
         now = time.monotonic()
         # deadline sweep and export-registry GC are throttled: both are
@@ -2153,6 +2234,7 @@ class InferenceEngine:
         self._admit_seq += 1
         slot.seq = self._admit_seq
         self.slot_adapters[free_slot] = self.adapter_index.get(req.adapter, 0)
+        self._mark_state_dirty("page_tables", "slot_adapters")
         # stage prefill bookkeeping BEFORE anything that can raise, so a
         # failure path releases exactly the acquired token prefix (shared
         # refcounts included) via slot.written
@@ -2560,6 +2642,9 @@ class InferenceEngine:
         self.positions[slot_idx] = n
         self.active[slot_idx] = True
         self.last_tokens[slot_idx] = first
+        self._remaining[slot_idx] = slot.remaining
+        self._batch_epoch += 1
+        self._mark_state_dirty("positions", "active", "last_tokens", "left")
         if req.first_token_time is None:
             req.first_token_time = time.monotonic()
         if req.params.has_penalties and self.token_counts is not None:
@@ -2738,6 +2823,9 @@ class InferenceEngine:
         # the pending input token is the last emitted output (its KV is
         # the next decode write); nothing new is emitted here
         self.last_tokens[free_slot] = req.output_tokens[-1]
+        self._remaining[free_slot] = slot.remaining
+        self._batch_epoch += 1
+        self._mark_state_dirty("positions", "active", "last_tokens", "left")
         logger.debug("restored %s: %d pages, resuming at %d",
                      req.req_id, n_pages, entry.written)
         return True
@@ -2841,6 +2929,7 @@ class InferenceEngine:
                 if page is not None:
                     self.page_tables[i, len(slot.pages)] = page
                     slot.pages.append(page)
+                    self._mark_state_dirty("page_tables")
                     continue
                 victim = self._newest_slot()
                 if victim is None or victim == i:
@@ -2882,15 +2971,18 @@ class InferenceEngine:
         if self.token_counts is not None:
             self.token_counts = counts
         self.counters["decode_steps_total"] += 1
-        toks = np.asarray(next_tokens)
-        lps = np.asarray(lps)
+        # one bulk D2H + tolist per dispatch: the replay loop then works
+        # on Python ints/floats instead of paying a scalar conversion
+        # per token
+        toks = np.asarray(next_tokens).tolist()
+        lps = np.asarray(lps).tolist()
         for i, slot in enumerate(self.slots):
             if not self.active[i]:
                 continue
             self.positions[i] += 1
             slot.position += 1
-            self._emit(i, int(toks[i]), logprob=float(lps[i]))
-            self.last_tokens[i] = int(toks[i])
+            self._emit(i, toks[i], logprob=lps[i])
+            self.last_tokens[i] = toks[i]
 
     def _decode_lookahead(self) -> int:
         """How many decode steps the next dispatch may fuse.  Full
@@ -2963,15 +3055,7 @@ class InferenceEngine:
         fn = self._decode_multi_fns.get(K)
         if fn is None:
             fn = self._decode_multi_fns[K] = self._build_decode_multi_fn(K)
-        S = len(self.slots)
-        stop = np.full((S, _STOP_WIDTH), -1, np.int32)
-        left = np.zeros((S,), np.int32)
-        for i, slot in enumerate(self.slots):
-            if slot.request is None or not self.active[i]:
-                continue
-            ids = sorted(self._stop_set(slot.request))
-            stop[i, :len(ids)] = ids
-            left[i] = slot.remaining
+        stop_dev = self._stop_matrix()
         counts_in, seen = self._penalty_args()
         cache, sampling, counts, toks, acts, lps = fn(
             self.params, self.cache, self.sampling, counts_in, seen,
@@ -2980,25 +3064,278 @@ class InferenceEngine:
             jnp.asarray(self.page_tables),
             jnp.asarray(self.active),
             jnp.asarray(self.slot_adapters),
-            jnp.asarray(stop),
-            jnp.asarray(left))
+            stop_dev,
+            jnp.asarray(self._remaining))
         self.cache = cache
         self.sampling = sampling
         if self.token_counts is not None:
             self.token_counts = counts
         self.counters["decode_steps_total"] += K
-        toks = np.asarray(toks)       # [K, S]
-        acts = np.asarray(acts)       # [K, S] — device active BEFORE step k
-        lps = np.asarray(lps)         # [K, S]
+        self._replay_window(K, np.asarray(toks), np.asarray(acts),
+                            np.asarray(lps))
+
+    def _replay_window(self, K: int, toks, acts, lps):
+        """Replay one fused window's [K, S] trace through the
+        single-step _emit path (stop handling, eviction, streaming).
+        The scan already deactivated finished slots on-device, so this
+        is reconciliation, not control.  One bulk tolist per array
+        keeps the K x S inner loop on Python scalars."""
+        toks = toks.tolist()          # [K, S]
+        acts = acts.tolist()          # [K, S] — device active BEFORE step k
+        lps = lps.tolist()            # [K, S]
         for k in range(K):
+            tk, ak, lk = toks[k], acts[k], lps[k]
             for i, slot in enumerate(self.slots):
                 # slot.request goes None when _emit retires it mid-trace
-                if not acts[k, i] or slot.request is None:
+                if not ak[i] or slot.request is None:
                     continue
                 self.positions[i] += 1
                 slot.position += 1
-                self._emit(i, int(toks[k, i]), logprob=float(lps[k, i]))
-                self.last_tokens[i] = int(toks[k, i])
+                self._emit(i, tk[i], logprob=lk[i])
+                self.last_tokens[i] = tk[i]
+
+    # ------------------------------------------------------------------
+    # Zero-bubble async decode loop (docs/decode-loop.md)
+    # ------------------------------------------------------------------
+    #
+    # Device-resident loop state + a two-deep dispatch pipeline: window
+    # N+1 is dispatched straight from the jitted scan's final carry
+    # while window N's [K, S] trace rides back via an async readback,
+    # so host postprocess (stop replay, _emit, streaming, scheduling)
+    # overlaps device compute.  The scan already deactivates slots
+    # in-scan on stop/budget, so the host replay is reconciliation, not
+    # control.  Any host-side batch change (admit, abort, preempt,
+    # spill, deadline eviction) drains the pipeline to depth 1 first —
+    # those paths read resume_tokens()/host mirrors and must see every
+    # emitted token.
+
+    def _mark_state_dirty(self, *names: str) -> None:
+        """Host mutated loop-state mirrors: re-upload them at the next
+        async dispatch (no-op when the async loop is off).  With no
+        args, marks everything (full re-sync)."""
+        if not self.async_dispatch:
+            return
+        self._state_dirty.update(names or self._STATE_FIELDS)
+
+    def _stop_matrix(self):
+        """Device [S, _STOP_WIDTH] stop matrix, cached on the batch
+        epoch: stop sets are per-request immutable, so batch membership
+        changes (admit/evict/restore) are the only invalidation.  Both
+        decode loops use this — the sync fused path stops rebuilding it
+        from Python loops on every dispatch."""
+        epoch, dev = self._stop_cache
+        if epoch == self._batch_epoch and dev is not None:
+            return dev
+        S = len(self.slots)
+        stop = np.full((S, _STOP_WIDTH), -1, np.int32)
+        for i, slot in enumerate(self.slots):
+            if slot.request is None or not self.active[i]:
+                continue
+            ids = sorted(self._stop_set(slot.request))
+            stop[i, :len(ids)] = ids
+        dev = jnp.asarray(stop)
+        self._stop_cache = (self._batch_epoch, dev)
+        return dev
+
+    def _device_state(self) -> dict:
+        """The device-resident loop state for the next dispatch.  Only
+        fields the host dirtied since the last dispatch are uploaded
+        (counted in kaito:engine_h2d_uploads_total — ~zero per dispatch
+        in steady state); everything else is the previous scan's carry,
+        already on device."""
+        src = {"last_tokens": self.last_tokens,
+               "positions": self.positions,
+               "active": self.active,
+               "page_tables": self.page_tables,
+               "slot_adapters": self.slot_adapters,
+               "left": self._remaining}
+        for name in self._STATE_FIELDS:
+            if name in self._state_dirty or name not in self._dev_state:
+                self._dev_state[name] = jnp.asarray(src[name])
+                self.counters["h2d_uploads_total"] += 1
+        self._state_dirty.clear()
+        return self._dev_state
+
+    def _retire_window(self, win) -> None:
+        """Block on window N's readback and replay its trace through
+        the normal _emit path.  By the time this runs, window N+1 is
+        usually already executing on device — the block overlaps its
+        compute instead of serializing with it."""
+        K, toks, acts, lps = win
+        toks = np.asarray(toks)      # blocks until the readback lands
+        acts = np.asarray(acts)
+        lps = np.asarray(lps)
+        self._last_ready_t = time.monotonic()
+        self._replay_window(K, toks, acts, lps)
+
+    def _drain_pipeline(self) -> None:
+        """Retire any in-flight window (pipeline back to depth 1).
+        After this, host mirrors are fully reconciled and paths that
+        read resume_tokens()/positions (preempt, spill, evict, abort,
+        spec) are safe."""
+        win, self._inflight = self._inflight, None
+        if win is not None:
+            self._retire_window(win)
+
+    def _must_drain(self) -> bool:
+        """Host-side batch changes that may run this step: admission is
+        possible (waiting work with a free slot, or QoS which may
+        preempt for one), a slot is mid-prefill/import (its
+        _begin_decode mutates loop state), or an abort is pending."""
+        if self._inflight is None:
+            return False
+        if self._waiting_count > 0 and (
+                self.qos is not None
+                or any(s.request is None for s in self.slots)):
+            return True
+        for slot in self.slots:
+            req = slot.request
+            if req is None:
+                continue
+            if slot.prefilling or slot.importing or req.aborted:
+                return True
+        return False
+
+    def _needs_sync_decode(self) -> bool:
+        """Conditions only the single-step host loop handles: pending
+        aborts (host-side knowledge) and stop sets wider than the
+        on-device matrix."""
+        for i, s in enumerate(self.slots):
+            if s.request is None:
+                continue
+            if s.request.aborted:
+                return True
+            if self.active[i] \
+                    and len(self._stop_set(s.request)) > _STOP_WIDTH:
+                return True
+        return False
+
+    def _decode_async(self, K: int) -> None:
+        """Dispatch one K-step fused window from device-resident state
+        and enqueue its readback; retire the PREVIOUS window after the
+        new one is on the device stream."""
+        fn = self._decode_multi_state_fns.get(K)
+        if fn is None:
+            fn = self._decode_multi_state_fns[K] = \
+                self._build_decode_multi_fn(K, with_state=True)
+        if self._inflight is not None \
+                and self._state_dirty & self._DEVICE_ADVANCED:
+            # the host mirrors of scan-advanced fields lag the window
+            # in flight: re-uploading them now would roll the device
+            # state back (double-granted budget, replayed positions).
+            # Reconcile first, then upload.
+            self._drain_pipeline()
+        stop_dev = self._stop_matrix()
+        state = self._device_state()
+        counts_in, seen = self._penalty_args()
+        t_dispatch = time.monotonic()
+        # device-idle gap: only the unprimed case exposes latency — a
+        # primed pipeline has window N still running while we are here
+        gap = (max(0.0, t_dispatch - self._last_ready_t)
+               if self._inflight is None and self._last_ready_t else 0.0)
+        cache, sampling, counts, toks, acts, lps, carry = fn(
+            self.params, self.cache, self.sampling, counts_in, seen,
+            state["last_tokens"], state["positions"],
+            state["page_tables"], state["active"],
+            state["slot_adapters"], stop_dev, state["left"])
+        self.cache = cache
+        self.sampling = sampling
+        if self.token_counts is not None:
+            self.token_counts = counts
+        nxt, pos, act, left = carry
+        self._dev_state.update(last_tokens=nxt, positions=pos, active=act,
+                               left=left)
+        for arr in (toks, acts, lps):
+            try:
+                arr.copy_to_host_async()
+            except Exception:      # backend without async copies
+                pass
+        self.counters["decode_steps_total"] += K
+        self._gap_last = gap
+        if self.dispatch_gap_hist is not None:
+            self.dispatch_gap_hist.observe(gap)
+        prev, self._inflight = self._inflight, (K, toks, acts, lps)
+        if prev is not None:
+            self._retire_window(prev)
+
+    def _step_async(self) -> bool:
+        """The async twin of _step_inner: same decode-priority
+        schedule, but fused dispatches go through the two-deep pipeline
+        and host work for window N runs while window N+1 computes."""
+        did0 = False
+        now = time.monotonic()
+        if now - self._last_deadline_sweep >= 0.05:
+            self._last_deadline_sweep = now
+            # queue expiry never touches device state; slot expiry
+            # evicts (reads written prefixes) — reconcile first
+            if self._inflight is not None and any(
+                    s.request is not None and s.request.deadline is not None
+                    for s in self.slots):
+                self._drain_pipeline()
+            did0 = self._expire_deadlines()
+        if now - self._last_export_tick >= 1.0:
+            self._last_export_tick = now
+            self.kv_exports.tick()
+        if self._must_drain():
+            self._drain_pipeline()
+        pend = self._inflight[0] if self._inflight is not None else 0
+        la = 1
+        if self.active.any():
+            la = self._decode_lookahead()
+            if pend and not self._lookahead_fits(la + pend):
+                # reservation must also cover the window in flight;
+                # when the pool can't, fall back to depth 1 so
+                # _ensure_decode_pages may preempt safely
+                self._drain_pipeline()
+                pend = 0
+            self._ensure_decode_pages(la + pend)
+        did = self._admit_new() or did0
+        if self._advance_imports():
+            did = True
+        decoding = bool(self.active.any())
+        steps_run = 0
+        if decoding:
+            if self._needs_sync_decode():
+                self._drain_pipeline()
+                self._decode_once()
+                self._mark_state_dirty()
+                steps_run = 1
+            elif self._spec_ok():
+                # speculation windows depend on each window's accepted
+                # length — inherently depth-1, but it still reads the
+                # reconciled host mirrors
+                self._drain_pipeline()
+                steps_run = self._decode_speculative()
+                self._mark_state_dirty()
+            if steps_run:
+                did = True
+            elif bool(self.active.any()):
+                la2 = self._decode_lookahead()
+                pend = self._inflight[0] if self._inflight is not None \
+                    else 0
+                while la2 > 1 and not self._lookahead_fits(la2 + pend):
+                    la2 //= 2
+                if pend and not self._lookahead_fits(la2 + pend):
+                    self._drain_pipeline()
+                    pend = 0
+                if did or la2 + pend > la:
+                    self._ensure_decode_pages(la2 + pend)
+                self._decode_async(la2)
+                steps_run = la2
+                did = True
+        elif self._inflight is not None:
+            # nothing left active on the host: the trailing window may
+            # still hold final tokens — retire it now
+            self._drain_pipeline()
+            did = True
+        self._tick += 1
+        self._decode_since_prefill += steps_run
+        if (not decoding) or self.cfg.prefill_interleave <= 1 \
+                or self._decode_since_prefill >= self.cfg.prefill_interleave:
+            if self._advance_prefills():
+                did = True
+                self._decode_since_prefill = 0
+        return did
 
     # ------------------------------------------------------------------
     # n-gram (prompt-lookup) speculative decoding
@@ -3158,8 +3495,10 @@ class InferenceEngine:
             self.params, self.cache, jnp.asarray(toks), jnp.asarray(tl),
             jnp.asarray(tables), jnp.asarray(sp), jnp.asarray(aids))
         self.cache = cache
-        targets = np.asarray(targets)
-        lps = np.asarray(lps)
+        # one bulk D2H + tolist per window: acceptance and replay run on
+        # Python scalars, not per-token np conversions
+        targets = np.asarray(targets).tolist()
+        lps = np.asarray(lps).tolist()
         self.counters["decode_steps_total"] += 1
         self.counters["spec_steps_total"] += 1
         max_emitted = 0
@@ -3167,10 +3506,11 @@ class InferenceEngine:
             slot = self.slots[i]
             if slot.request is None:
                 continue
+            trow, lrow = targets[r], lps[r]
             a = 0
-            while a < len(p) and p[a] == int(targets[r, a]):
+            while a < len(p) and p[a] == trow[a]:
                 a += 1
-            emitted = p[:a] + [int(targets[r, a])]
+            emitted = p[:a] + [trow[a]]
             self.counters["spec_proposed_tokens_total"] += len(p)
             self.counters["spec_accepted_tokens_total"] += a
             want_lp = slot.request.params.logprobs
@@ -3179,8 +3519,7 @@ class InferenceEngine:
                     break        # retired mid-window (stop/budget/abort)
                 self.positions[i] += 1
                 slot.position += 1
-                self._emit(i, t,
-                           logprob=float(lps[r, j]) if want_lp else None)
+                self._emit(i, t, logprob=lrow[j] if want_lp else None)
                 self.last_tokens[i] = t
             max_emitted = max(max_emitted, len(emitted))
         return max_emitted
@@ -3297,9 +3636,10 @@ class InferenceEngine:
             if k_exec < W - 1:
                 dlogits = jnp.pad(
                     dlogits, ((0, 0), (0, W - 1 - k_exec), (0, 0)))
+            props = np.asarray(props).tolist()
             for r, i in enumerate(rows):
                 if depths[i] > 0:
-                    proposals[i] = [int(t) for t in props[r, :depths[i]]]
+                    proposals[i] = props[r][:depths[i]]
         else:
             dlogits = jnp.zeros((B, W - 1, self.md.arch.vocab_size),
                                 jnp.float32)
@@ -3319,9 +3659,9 @@ class InferenceEngine:
             jnp.asarray(onehot), keys)
         self.cache = cache
         runner.scatter_keys(slot_map, new_keys)
-        out = np.asarray(out)
-        n_emit = np.asarray(n_emit)
-        lps = np.asarray(lps)
+        out = np.asarray(out).tolist()
+        n_emit = np.asarray(n_emit).tolist()
+        lps = np.asarray(lps).tolist()
         self.counters["decode_steps_total"] += 1
         self.counters["spec_steps_total"] += 1
         if k_exec > 0:
@@ -3333,7 +3673,7 @@ class InferenceEngine:
             if slot.request is None:
                 continue
             p = proposals[i]
-            e = int(n_emit[r])
+            e = n_emit[r]
             a = e - 1       # accepted proposal prefix
             if depths[i] > 0:
                 self.counters["spec_draft_rows_total"] += 1
@@ -3344,14 +3684,14 @@ class InferenceEngine:
                 self.counters["spec_proposed_tokens_total"] += len(p)
                 self.counters["spec_accepted_tokens_total"] += a
             want_lp = slot.request.params.logprobs
-            emitted = [int(t) for t in out[r, :e]]
+            emitted = out[r][:e]
+            lrow = lps[r]
             for j, t in enumerate(emitted):
                 if slot.request is None:
                     break        # retired mid-window (stop/budget/abort)
                 self.positions[i] += 1
                 slot.position += 1
-                self._emit(i, t,
-                           logprob=float(lps[r, j]) if want_lp else None)
+                self._emit(i, t, logprob=lrow[j] if want_lp else None)
                 self.last_tokens[i] = t
             if slot.request is not None and depths[i] > 0:
                 # the proposal scan wrote draft KV at sp..sp+k_exec-1
@@ -3386,6 +3726,7 @@ class InferenceEngine:
         if req.params.logprobs:
             req.output_logprobs.append(logprob)
         slot.remaining -= 1
+        self._remaining[slot_idx] = slot.remaining
         self.counters["generation_tokens_total"] += 1
 
         stop_ids = self._stop_set(req)

@@ -312,7 +312,8 @@ class EngineMetrics:
             # the engine owns its step/queue-wait histograms (observed
             # from the scheduler thread); expose them through this
             # registry rather than duplicating series
-            for attr in ("step_hist", "queue_wait_hist"):
+            for attr in ("step_hist", "queue_wait_hist",
+                         "dispatch_gap_hist"):
                 h = getattr(engine, attr, None)
                 if h is not None:
                     r.register(h)
@@ -426,6 +427,18 @@ class EngineMetrics:
                       "Prefix fetches that fell back to local recompute",
                       r, fn=lambda: engine.counters.get(
                           "kv_pool_fetch_failures_total", 0))
+            if getattr(engine, "async_dispatch", False):
+                # zero-bubble decode loop (docs/decode-loop.md): the
+                # family exists ONLY with the async loop on — the
+                # dispatch-gap histogram above is gated the same way
+                # (engine attr is None when off), so the flag-off
+                # exposition stays byte-identical
+                Gauge("kaito:engine_h2d_uploads_total",
+                      "Loop-state arrays uploaded host-to-device at "
+                      "decode dispatch (~zero per dispatch in steady "
+                      "state)", r,
+                      fn=lambda: engine.counters.get(
+                          "h2d_uploads_total", 0))
             if getattr(engine, "adapter_cache", None) is not None:
                 # dynamic multi-LoRA cache (docs/multi-lora.md):
                 # families exist ONLY with the cache enabled — same

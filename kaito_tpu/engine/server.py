@@ -1697,6 +1697,14 @@ def main(argv=None):
                     default=int(os.environ.get("KAITO_KV_POOL_BYTES",
                                                str(1 << 30))),
                     help="host bytes for the replica-local prefix store")
+    ap.add_argument("--async-dispatch", action="store_true",
+                    default=os.environ.get("KAITO_ASYNC_DISPATCH", "")
+                    in ("1", "true"),
+                    help="zero-bubble decode loop (docs/decode-loop.md): "
+                         "device-resident loop state + a two-deep dispatch "
+                         "pipeline overlapping host postprocess with device "
+                         "compute (default off; off keeps the synchronous "
+                         "loop and /metrics byte-identical)")
     ap.add_argument("--kaito-disable-rate-limit", action="store_true")
     ap.add_argument("--enable-prefix-caching", dest="enable_prefix_caching",
                     action="store_true", default=True,
@@ -1791,6 +1799,7 @@ def main(argv=None):
         pd_source_allowlist=args.pd_source_allowlist,
         kv_pool_enabled=args.kv_pool,
         kv_pool_bytes=args.kv_pool_bytes,
+        async_dispatch=args.async_dispatch,
         disable_rate_limit=args.kaito_disable_rate_limit,
         enable_prefix_caching=args.enable_prefix_caching,
         host_kv_offload_bytes=int(

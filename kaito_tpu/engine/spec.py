@@ -446,6 +446,14 @@ class DraftRunner:
             jnp.asarray(temps, jnp.float32),
             keys)
         self.cache = cache
+        # enqueue the proposal readback before the key scatter so the
+        # D2H copy rides the device stream alongside the scatter
+        # dispatch instead of serializing after it (the blocking
+        # np.asarray below then usually finds the bytes already landed)
+        try:
+            toks.copy_to_host_async()
+        except Exception:          # backend without async copies
+            pass
         self.scatter_keys(slot_map, new_keys,
                           only=np.asarray(active, bool))
         return np.asarray(toks), dlogits

@@ -37,6 +37,7 @@ from typing import Iterable, Optional
 __all__ = [
     "Span", "RingTracer", "StepTimeline",
     "chrome_trace", "timeline_trace", "format_span_tree",
+    "decode_gap_summary",
     "parse_traceparent", "sanitize_request_id", "make_request_id",
 ]
 
@@ -209,6 +210,31 @@ class StepTimeline:
     def __len__(self) -> int:
         with self._lock:
             return len(self._records)
+
+
+def decode_gap_summary(records: Iterable[dict]) -> tuple[float, float]:
+    """``(device_idle_pct, mean_gap_ms)`` over the timeline records
+    that carry a ``dispatch_gap`` field (the async decode loop's
+    per-step dispatch-gap span, docs/decode-loop.md).
+
+    ``device_idle_pct`` is total gap time over total step wall time for
+    decode steps — the fraction of the decode wall clock the device
+    spent waiting on the host.  Both are 0.0 when the async loop is off
+    (no record carries the field), so bench columns stay schema-stable
+    either way."""
+    gaps: list[float] = []
+    wall = 0.0
+    for rec in records:
+        g = rec.get("dispatch_gap")
+        if g is None or not rec.get("decode_steps", 0):
+            continue
+        gaps.append(float(g))
+        wall += float(rec.get("dur", 0.0))
+    if not gaps or wall <= 0.0:
+        return 0.0, 0.0
+    total_gap = sum(gaps)
+    return (min(100.0, 100.0 * total_gap / wall),
+            1e3 * total_gap / len(gaps))
 
 
 def timeline_trace(records: Iterable[dict]) -> dict:

@@ -26,6 +26,7 @@ import pytest  # noqa: E402
 # `make unit-test-fast` deselects them: the fast tier covers the
 # operator/controller/RAG/API surface in well under a minute.
 _SLOW_MODULES = {
+    "test_async_dispatch",
     "test_chunked_prefill", "test_cp_serve", "test_decode_run_ahead",
     "test_dp_router", "test_dp_serve",
     "test_e2e_sim", "test_engine_core", "test_engine_model",
