@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: all native unit-test unit-test-fast unit-test-slow engine-test rag-test chaos kvq wquant kvpool lora obs slo fleet autoscale spec qos asyncloop bench serve manager epp clean
+.PHONY: all native unit-test unit-test-fast unit-test-slow engine-test rag-test chaos kvq wquant kvpool lora structured obs slo fleet autoscale spec qos asyncloop bench serve manager epp clean
 
 all: native
 
@@ -65,6 +65,15 @@ kvpool:
 # e2e over two real engines is the slow leg
 lora:
 	$(PYTHON) -m pytest tests/test_multi_lora.py -q -m "not slow"
+
+# grammar-constrained decoding suite (docs/structured-output.md):
+# schema/regex -> token-mask compilation, cache/table, always-valid
+# output across greedy/sampled x ngram/draft spec x async dispatch,
+# all-ones-mask bit-equivalence, response_format + tools API surface,
+# streaming tool_calls deltas, gated metrics + fleet fold, annotation
+# render/plan validation
+structured:
+	$(PYTHON) -m pytest tests/test_grammar.py -q -m "not slow"
 
 # observability suite (docs/observability.md): tracing, flight
 # recorder, router metrics, exposition-format invariants, control-plane

@@ -126,12 +126,22 @@ def plan_workspace(store: Store, ws: Workspace):
     # the parse helper lives in manifests (jax-free) and is the exact
     # code the renderer runs, so plan-time acceptance == render-time
     # acceptance (docs/multi-lora.md)
-    from kaito_tpu.manifests.inference import parse_adapters_annotation
+    from kaito_tpu.manifests.inference import (
+        parse_adapters_annotation, parse_structured_output_annotation)
     try:
         parse_adapters_annotation(ws.metadata.annotations.get(
             "kaito-tpu.io/adapters", ""))
     except ValueError as e:
         raise ValueError(f"invalid kaito-tpu.io/adapters annotation: {e}")
+    # a malformed structured-output document fails the plan the same
+    # way — again the exact parse the renderer runs, so plan-time
+    # acceptance == render-time acceptance (docs/structured-output.md)
+    try:
+        parse_structured_output_annotation(ws.metadata.annotations.get(
+            "kaito-tpu.io/structured-output", ""))
+    except ValueError as e:
+        raise ValueError(
+            f"invalid kaito-tpu.io/structured-output annotation: {e}")
     # CP prefill auto-carve is evidence-gated (plan_parallelism
     # docstring: BENCH_r05 cp_speedup 0.68 < 1.0) — serve plans
     # only carve a sequence axis when the user opts in

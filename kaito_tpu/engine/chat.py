@@ -10,7 +10,56 @@ from the model id, falling back to generic ChatML.
 
 from __future__ import annotations
 
+import json
 from typing import Mapping, Optional, Sequence
+
+
+def normalize_tool_messages(messages: Sequence[Mapping]) -> list:
+    """Flatten tool-protocol messages into plain role/content turns.
+
+    The per-family templates only understand ``{"role", "content"}``
+    pairs, but multi-turn tool conversations carry two extra shapes the
+    OpenAI API defines: assistant messages with a ``tool_calls`` list
+    (and often null content), and ``role: "tool"`` result messages.
+    Rendering those verbatim would drop the calls and emit an unknown
+    role token, so the follow-up generation loses the context of what
+    it called and what came back.
+
+    Assistant tool calls are rendered as the same compact JSON envelope
+    the constrained decoder emits (``{"name": ..., "arguments": ...}``),
+    so the transcript the model sees round-trips its own output format.
+    Tool results become ``tool`` turns with the call name folded into
+    the content; templates without a native tool role still render them
+    as a distinct turn.
+    """
+    out = []
+    for m in messages:
+        role = m.get("role", "user")
+        if role == "assistant" and m.get("tool_calls"):
+            parts = []
+            content = m.get("content") or ""
+            if content:
+                parts.append(content)
+            for call in m.get("tool_calls") or ():
+                fn = (call or {}).get("function") or {}
+                args = fn.get("arguments", "{}")
+                if not isinstance(args, str):
+                    args = json.dumps(args, separators=(",", ":"))
+                parts.append(json.dumps(
+                    {"name": fn.get("name", ""), "arguments": args},
+                    separators=(",", ":")))
+            out.append({"role": "assistant", "content": "\n".join(parts)})
+        elif role == "tool":
+            content = m.get("content") or ""
+            if not isinstance(content, str):
+                content = json.dumps(content, separators=(",", ":"))
+            name = m.get("name") or ""
+            if name:
+                content = f"{name}: {content}"
+            out.append({"role": "tool", "content": content})
+        else:
+            out.append(dict(m))
+    return out
 
 
 def _llama3(messages) -> str:
@@ -95,6 +144,10 @@ def _mistral(messages) -> str:
             out.append(f"[INST] {body} [/INST]")
         elif role == "assistant":
             out.append(f" {content}</s>")
+        elif role == "tool":
+            # mistral wire format carries tool results in their own
+            # bracketed block, not inside [INST]
+            out.append(f"[TOOL_RESULTS] {content} [/TOOL_RESULTS]")
     if pending_system:
         # a TRAILING system message (no user turn after it) still has
         # to steer the generation — emit it as its own instruction
@@ -119,6 +172,10 @@ def _deepseek(messages, strip_think: bool = False) -> str:
     for m in messages:
         role, content = m.get("role"), m.get("content", "")
         if role == "user":
+            out.append(f"<｜User｜>{content}")
+        elif role == "tool":
+            # no dedicated tool turn in the distill templates — feed
+            # the result back as a user turn so it isn't dropped
             out.append(f"<｜User｜>{content}")
         elif role == "assistant":
             if strip_think and "</think>" in content:
@@ -170,6 +227,7 @@ def template_for(model_id: str):
 
 def render_chat(tokenizer, messages: Sequence[Mapping[str, str]],
                 model_id: str = "") -> str:
+    messages = normalize_tool_messages(messages)
     apply = getattr(tokenizer, "apply_chat_template", None)
     if apply is not None:
         try:

@@ -131,6 +131,20 @@ class EngineConfig:
     kv_pool_bytes: int = 1 << 30         # host bytes for the prefix store
     kv_pool_min_tokens: int = 0          # min prefix tokens to publish
     # (0 = one KV page, i.e. page_size tokens)
+    # grammar-constrained decoding (docs/structured-output.md):
+    # response_format={json_schema|json_object|regex} and forced tool
+    # calls compile into token-level masks applied on device.  The
+    # surface is on by default but completely pay-per-use: with no
+    # constrained request in flight the decode path compiles the mask
+    # branch away and the /metrics exposition is byte-identical.
+    # False rejects response_format/tools-constrained requests with a
+    # typed 400 (fleet operators pinning the old surface).
+    structured_output: bool = True
+    grammar_cache_entries: int = 64      # compiled-schema LRU entries
+    # DFA state cap per grammar; each state costs O(vocab) device bytes
+    # in the packed mask table, so this bounds both compile time and
+    # the table footprint
+    grammar_max_states: int = 512
     # multi-tenant QoS (docs/qos.md): JSON tenant-class document
     # (inline, or @path to a file) parsed by engine.qos.  "" = off —
     # one implicit tenant, legacy FIFO admission and

@@ -38,6 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from kaito_tpu.engine.config import EngineConfig
+from kaito_tpu.engine.grammar import GrammarCache, GrammarSlot, GrammarTable
 from kaito_tpu.engine.kv_cache import (KVCache, create_kv_cache,
                                        scale_bytes_per_page)
 from kaito_tpu.engine.model import TransformerLM
@@ -87,6 +88,12 @@ class SamplingParams:
     frequency_penalty: float = 0.0
     repetition_penalty: float = 1.0
     min_p: float = 0.0
+    # grammar-constrained decoding (docs/structured-output.md): a
+    # grammar.CompiledGrammar the server resolved from response_format
+    # or a forced tool_choice BEFORE admission (compilation never runs
+    # in the step thread).  None = unconstrained.
+    grammar: Optional[object] = field(default=None, compare=False,
+                                      repr=False)
 
     @property
     def has_penalties(self) -> bool:
@@ -223,10 +230,14 @@ class InferenceEngine:
     # (uploading a stale mirror would roll the device state back).
     # page_tables / slot_adapters are host-only-written and safe to
     # re-upload while a window is in flight.
+    # "gstate" is the per-slot grammar-automaton row (host mirror:
+    # _gram_state, advanced by _emit along the replay path) — the scan
+    # advances it in-device, so constrained decoding rides the async
+    # pipeline drain-free like the rest of the loop state.
     _STATE_FIELDS = ("last_tokens", "positions", "active", "page_tables",
-                     "slot_adapters", "left")
+                     "slot_adapters", "left", "gstate")
     _DEVICE_ADVANCED = frozenset(("last_tokens", "positions", "active",
-                                  "left"))
+                                  "left", "gstate"))
 
     def __init__(
         self,
@@ -656,6 +667,22 @@ class InferenceEngine:
         self._batch_epoch = 0
         self._stop_cache: tuple = (-1, None)
 
+        # grammar-constrained decoding (docs/structured-output.md).
+        # The compiled-schema LRU always exists (the server compiles
+        # against it pre-admission), but the packed device table — like
+        # the penalty state above — is allocated lazily on the first
+        # constrained admission, so grammar-free engines keep the [1,1]
+        # placeholder path compiled away and never retrace.
+        self.grammar_cache = GrammarCache(
+            entries=getattr(cfg, "grammar_cache_entries", 64),
+            max_states=getattr(cfg, "grammar_max_states", 512))
+        self._gram_table: Optional[GrammarTable] = None
+        self._gram_slots: list[Optional[GrammarSlot]] = [None] * S
+        self._gram_state = np.zeros((S,), np.int32)
+        self._dev_gmask = None
+        self._dev_gtrans = None
+        self._gram_version = 0
+
         from kaito_tpu.engine.pd import KVExportRegistry, TransferCostModel
 
         self.kv_exports = KVExportRegistry()
@@ -1055,7 +1082,8 @@ class InferenceEngine:
 
         @partial(jax.jit, donate_argnums=(1, 2, 3))
         def decode_step(params, cache, sampling, counts, prompt_seen,
-                        tokens, positions, page_tables, active, adapter_ids):
+                        tokens, positions, page_tables, active, adapter_ids,
+                        gmask, gtrans, gstate):
             if pp_decode is not None:
                 cache, logits = pp_decode(params, cache, tokens, positions,
                                           page_tables, active,
@@ -1064,8 +1092,13 @@ class InferenceEngine:
                 cache, logits = model.decode(params, cache, tokens, positions,
                                              page_tables, active,
                                              adapter_ids=adapter_ids)
+            # grammar masks: one gather of 0/-inf rows per constrained
+            # batch ([1,1] placeholders compile the path away; row 0 is
+            # the all-zero unconstrained row, so mixed batches cost the
+            # same single gather)
+            grows = gmask[gstate] if gmask.shape[0] > 1 else None
             next_tokens, new_sampling = sample(logits, sampling, counts,
-                                               prompt_seen)
+                                               prompt_seen, grows)
             # inactive rows keep their PRNG keys: a sampled stream must
             # be seed-deterministic regardless of co-tenant scheduling
             # (prefilling/idle rows never burn draws)
@@ -1106,14 +1139,15 @@ class InferenceEngine:
         @partial(jax.jit, donate_argnums=(1, 2, 3))
         def decode_multi(params, cache, sampling, counts, prompt_seen,
                          tokens, positions, page_tables, active, adapter_ids,
-                         stop_ids, steps_left):
+                         stop_ids, steps_left, gmask, gtrans, gstate):
             def body(carry, _):
-                cache, sampling, counts, toks, pos, act, left = carry
+                cache, sampling, counts, toks, pos, act, left, gst = carry
                 cache, logits = model.decode(params, cache, toks, pos,
                                              page_tables, act,
                                              adapter_ids=adapter_ids)
+                grows = gmask[gst] if gmask.shape[0] > 1 else None
                 nxt, new_sampling = sample(logits, sampling, counts,
-                                           prompt_seen)
+                                           prompt_seen, grows)
                 sampling = SamplingState(
                     temperature=new_sampling.temperature,
                     top_k=new_sampling.top_k, top_p=new_sampling.top_p,
@@ -1130,20 +1164,25 @@ class InferenceEngine:
                     counts = counts.at[jnp.arange(B), nxt].add(
                         act.astype(jnp.int32))
                 left = left - act.astype(jnp.int32)
+                # advance the grammar automaton in-scan on the emitted
+                # token (transition rows hold absolute table rows; the
+                # unconstrained row 0 self-loops on every token)
+                if gmask.shape[0] > 1:
+                    gst = jnp.where(act, gtrans[gst, nxt], gst)
                 # stop_ids is -1-padded, token ids are >= 0
                 hit = jnp.any(nxt[:, None] == stop_ids, axis=1)
                 act_next = act & ~hit & (left > 0)
                 pos = pos + act.astype(jnp.int32)
-                return (cache, sampling, counts, nxt, pos, act_next, left), \
-                    (nxt, act, lp)
+                return (cache, sampling, counts, nxt, pos, act_next, left,
+                        gst), (nxt, act, lp)
 
             carry = (cache, sampling, counts, tokens, positions, active,
-                     steps_left)
-            (cache, sampling, counts, nxt, pos, act, left), \
+                     steps_left, gstate)
+            (cache, sampling, counts, nxt, pos, act, left, gst), \
                 (toks, acts, lps) = jax.lax.scan(body, carry, None, length=K)
             if with_state:
                 return (cache, sampling, counts, toks, acts, lps,
-                        (nxt, pos, act, left))
+                        (nxt, pos, act, left, gst))
             return cache, sampling, counts, toks, acts, lps
 
         return decode_multi
@@ -1817,6 +1856,7 @@ class InferenceEngine:
         if self.spec_ctl is not None:
             self.spec_ctl.reset(slot_idx)
         self._ngram_idx.pop(slot_idx, None)
+        self._release_grammar(slot_idx)
         slot.request = None
         slot.pages = []
         slot.prefilling = False
@@ -2280,6 +2320,8 @@ class InferenceEngine:
                 pmask[np.clip(np.asarray(req.prompt_tokens), 0, V - 1)] = True
                 self.prompt_seen = self.prompt_seen.at[free_slot].set(
                     jnp.asarray(pmask))
+            if req.params.grammar is not None:
+                self._install_grammar(free_slot, req)
             if req.kv_import is not None:
                 self._start_imported(req, free_slot)
                 return True
@@ -2612,10 +2654,15 @@ class InferenceEngine:
             frequency=s.frequency[slot_idx:slot_idx + 1],
             repetition=s.repetition[slot_idx:slot_idx + 1],
             min_p=s.min_p[slot_idx:slot_idx + 1])
+        gs = self._gram_slots[slot_idx]
+        gr = (jnp.asarray(self._gram_row(gs))[None, :]
+              if gs is not None else None)
         if self.token_counts is not None:
             tok, sub = self._sample_one(
                 logits, sub, self.token_counts[slot_idx:slot_idx + 1],
-                self.prompt_seen[slot_idx:slot_idx + 1])
+                self.prompt_seen[slot_idx:slot_idx + 1], gr)
+        elif gr is not None:
+            tok, sub = self._sample_one(logits, sub, None, None, gr)
         else:
             tok, sub = self._sample_one(logits, sub)
         lp = float(chosen_logprob(jnp.asarray(logits), tok)[0])
@@ -2957,15 +3004,135 @@ class InferenceEngine:
             self.token_counts = jnp.zeros((S, V), jnp.int32)
             self.prompt_seen = jnp.zeros((S, V), bool)
 
+    # ------------------------------------------------------------------
+    # Grammar-constrained decoding state (docs/structured-output.md)
+    # ------------------------------------------------------------------
+
+    def _grammar_args(self):
+        """(gmask, gtrans, gstate) for the decode programs: the packed
+        live tables, or [1, 1] placeholders that compile the grammar
+        path away (same discipline as _penalty_args)."""
+        if self._gram_table is None:
+            return (jnp.zeros((1, 1), jnp.float32),
+                    jnp.zeros((1, 1), jnp.int32),
+                    jnp.zeros((len(self.slots),), jnp.int32))
+        self._refresh_grammar_device()
+        return (self._dev_gmask, self._dev_gtrans,
+                jnp.asarray(self._gram_state))
+
+    def _refresh_grammar_device(self):
+        """Re-upload the packed tables when their content changed.  The
+        device arrays span the table's full (power-of-two) capacity, so
+        installing a schema into spare rows re-uploads bytes but never
+        changes shapes — the decode programs retrace only when the
+        table actually grows."""
+        tbl = self._gram_table
+        if tbl is None or self._gram_version == tbl.version:
+            return
+        self._dev_gmask = jnp.asarray(tbl.mask)
+        self._dev_gtrans = jnp.asarray(tbl.trans)
+        self._gram_version = tbl.version
+
+    def _sync_gram_state(self):
+        """Recompute the absolute table row of every constrained slot
+        from the host mirrors (table repack moves bases; admission /
+        eviction changes membership) and mark it for re-upload."""
+        tbl = self._gram_table
+        for i, gs in enumerate(self._gram_slots):
+            if gs is None:
+                self._gram_state[i] = 0
+                continue
+            if gs.version != tbl.version:
+                gs.base = tbl.base_of(gs.grammar.key)
+                gs.version = tbl.version
+            self._gram_state[i] = gs.base + gs.state
+        self._mark_state_dirty("gstate")
+
+    def _gram_row(self, gs: GrammarSlot) -> np.ndarray:
+        """The slot's CURRENT 0/-inf mask row, padded to the model
+        vocab (tokenizer vocab may be narrower)."""
+        row = gs.grammar.mask_rows_f32()[gs.state]
+        V = self.md.arch.vocab_size
+        if row.shape[0] < V:
+            row = np.pad(row, (0, V - row.shape[0]),
+                         constant_values=np.float32(-np.inf))
+        return row
+
+    def _install_grammar(self, slot_idx: int, req: Request) -> None:
+        """Pin the request's compiled grammar into the packed table and
+        build the slot's host mirror.  Resume-after-preemption replays
+        the already-generated output through the automaton, so the mask
+        continues exactly where the evicted slot left off."""
+        g = req.params.grammar
+        if self._gram_table is None:
+            V = self.md.arch.vocab_size
+            logger.info("allocating grammar table (vocab %d)", V)
+            self._gram_table = GrammarTable(V)
+        base = self._gram_table.acquire(g)
+        gs = GrammarSlot(grammar=g, base=base,
+                         version=self._gram_table.version)
+        for t in req.output_tokens:
+            gs.advance(int(t))
+        self._gram_slots[slot_idx] = gs
+        if not req.preemptions and not req.output_tokens:
+            self.grammar_cache.requests_total += 1
+        # acquire may have grown/repacked the table: every slot's base
+        # is re-derived, and the device copies refresh on next dispatch
+        self._sync_gram_state()
+
+    def _release_grammar(self, slot_idx: int) -> None:
+        gs = self._gram_slots[slot_idx]
+        if gs is None:
+            return
+        self._gram_table.release(gs.grammar.key)
+        self._gram_slots[slot_idx] = None
+        self._gram_state[slot_idx] = 0
+        self._mark_state_dirty("gstate")
+
+    def _truncate_for_grammar(self, slot_idx: int, p: list) -> list:
+        """Clip a speculative proposal at the first grammar-invalid
+        token (walking the automaton host-side, without mutating the
+        slot's live state).  The surviving prefix is exactly what
+        masked verification could ever accept, so clipping here only
+        saves wasted verify positions."""
+        gs = self._gram_slots[slot_idx]
+        if gs is None or not p:
+            return p
+        st, out = gs.state, []
+        for t in p:
+            if not gs.grammar.allows(st, int(t)):
+                break
+            out.append(t)
+            st = gs.grammar.advance(st, int(t))
+        return out
+
+    def _gram_rows_for(self, slot_idx: int, p: list, W: int) -> np.ndarray:
+        """Absolute mask-table row per verify-window position: position
+        j holds the grammar state BEFORE the token verified at j (the
+        state after j accepted proposal tokens).  Unconstrained slots
+        get row 0 (the reserved no-op row)."""
+        row = np.zeros((W,), np.int32)
+        gs = self._gram_slots[slot_idx]
+        if gs is None:
+            return row
+        st = gs.state
+        for j in range(W):
+            row[j] = gs.base + st
+            if j < len(p):
+                st = gs.grammar.advance(st, int(p[j]))
+        return row
+
     def _decode_once(self):
         counts_in, seen = self._penalty_args()
+        gmask, gtrans, gstate = self._grammar_args()
         cache, sampling, counts, next_tokens, lps = self._decode_fn(
             self.params, self.cache, self.sampling, counts_in, seen,
             jnp.asarray(self.last_tokens),
             jnp.asarray(self.positions),
             jnp.asarray(self.page_tables),
             jnp.asarray(self.active),
-            jnp.asarray(self.slot_adapters))
+            jnp.asarray(self.slot_adapters),
+            gmask, gtrans, gstate)
         self.cache = cache
         self.sampling = sampling
         if self.token_counts is not None:
@@ -3057,6 +3224,7 @@ class InferenceEngine:
             fn = self._decode_multi_fns[K] = self._build_decode_multi_fn(K)
         stop_dev = self._stop_matrix()
         counts_in, seen = self._penalty_args()
+        gmask, gtrans, gstate = self._grammar_args()
         cache, sampling, counts, toks, acts, lps = fn(
             self.params, self.cache, self.sampling, counts_in, seen,
             jnp.asarray(self.last_tokens),
@@ -3065,7 +3233,8 @@ class InferenceEngine:
             jnp.asarray(self.active),
             jnp.asarray(self.slot_adapters),
             stop_dev,
-            jnp.asarray(self._remaining))
+            jnp.asarray(self._remaining),
+            gmask, gtrans, gstate)
         self.cache = cache
         self.sampling = sampling
         if self.token_counts is not None:
@@ -3148,7 +3317,8 @@ class InferenceEngine:
                "active": self.active,
                "page_tables": self.page_tables,
                "slot_adapters": self.slot_adapters,
-               "left": self._remaining}
+               "left": self._remaining,
+               "gstate": self._gram_state}
         for name in self._STATE_FIELDS:
             if name in self._state_dirty or name not in self._dev_state:
                 self._dev_state[name] = jnp.asarray(src[name])
@@ -3228,6 +3398,7 @@ class InferenceEngine:
         stop_dev = self._stop_matrix()
         state = self._device_state()
         counts_in, seen = self._penalty_args()
+        gmask, gtrans, _ = self._grammar_args()
         t_dispatch = time.monotonic()
         # device-idle gap: only the unprimed case exposes latency — a
         # primed pipeline has window N still running while we are here
@@ -3237,14 +3408,15 @@ class InferenceEngine:
             self.params, self.cache, self.sampling, counts_in, seen,
             state["last_tokens"], state["positions"],
             state["page_tables"], state["active"],
-            state["slot_adapters"], stop_dev, state["left"])
+            state["slot_adapters"], stop_dev, state["left"],
+            gmask, gtrans, state["gstate"])
         self.cache = cache
         self.sampling = sampling
         if self.token_counts is not None:
             self.token_counts = counts
-        nxt, pos, act, left = carry
+        nxt, pos, act, left, gst = carry
         self._dev_state.update(last_tokens=nxt, positions=pos, active=act,
-                               left=left)
+                               left=left, gstate=gst)
         for arr in (toks, acts, lps):
             try:
                 arr.copy_to_host_async()
@@ -3399,7 +3571,21 @@ class InferenceEngine:
 
             @partial(jax.jit, donate_argnums=(1,))
             def verify(params, cache, tokens, true_lens, page_tables,
-                       start_pos, adapter_ids):
+                       start_pos, adapter_ids, gmask, grows):
+                if gmask.shape[0] > 1:
+                    # constrained rows: greedy targets are the argmax of
+                    # the MASKED logits (matches the plain decode path
+                    # bit-exactly); reported logprobs stay on the model
+                    # distribution (OpenAI logprob semantics)
+                    cache, logits = model.verify_window_logits(
+                        params, cache, tokens, true_lens, page_tables,
+                        start_pos, adapter_ids=adapter_ids)
+                    masked = logits + gmask[grows]
+                    targets = jnp.argmax(masked, axis=-1).astype(jnp.int32)
+                    lps = jnp.take_along_axis(
+                        jax.nn.log_softmax(logits, axis=-1),
+                        targets[..., None], axis=-1)[..., 0]
+                    return cache, targets, lps
                 return model.verify_window(params, cache, tokens,
                                            true_lens, page_tables,
                                            start_pos,
@@ -3422,13 +3608,15 @@ class InferenceEngine:
             def verify_accept(params, cache, tokens, true_lens,
                               page_tables, start_pos, adapter_ids,
                               draft_logits, prop_len, temperature,
-                              onehot_q, keys):
+                              onehot_q, keys, gmask, grows):
                 cache, logits = model.verify_window_logits(
                     params, cache, tokens, true_lens, page_tables,
                     start_pos, adapter_ids=adapter_ids)
+                grammar_rows = gmask[grows] if gmask.shape[0] > 1 else None
                 out, n_emit, lps, new_keys = spec_verify_sample(
                     logits, draft_logits, tokens[:, 1:], prop_len,
-                    temperature, onehot_q, keys)
+                    temperature, onehot_q, keys,
+                    grammar_rows=grammar_rows)
                 return cache, out, n_emit, lps, new_keys
 
             fn = self._prefill_fns[key] = verify_accept
@@ -3468,6 +3656,8 @@ class InferenceEngine:
             # never speculate past the budget: tokens beyond remaining
             # would be emitted-and-truncated work
             p = p[: max(0, slot.remaining - 1)]
+            # constrained slots: clip at the first grammar-invalid token
+            p = self._truncate_for_grammar(i, p)
             any_proposal = any_proposal or bool(p)
             rows.append(i)
             proposals.append(p)
@@ -3484,6 +3674,7 @@ class InferenceEngine:
         sp = np.zeros((B,), np.int32)
         tables = np.zeros((B, self.pages_per_seq), np.int32)
         aids = np.zeros((B,), np.int32)
+        grows = np.zeros((B, W), np.int32)
         for r, (i, p) in enumerate(zip(rows, proposals)):
             window = [int(self.last_tokens[i])] + p
             toks[r, : len(window)] = window
@@ -3491,9 +3682,12 @@ class InferenceEngine:
             sp[r] = self.slots[i].position
             tables[r] = self.page_tables[i]
             aids[r] = self.slot_adapters[i]
+            grows[r] = self._gram_rows_for(i, p, W)
+        gmask, _, _ = self._grammar_args()
         cache, targets, lps = self._verify_fn(W)(
             self.params, self.cache, jnp.asarray(toks), jnp.asarray(tl),
-            jnp.asarray(tables), jnp.asarray(sp), jnp.asarray(aids))
+            jnp.asarray(tables), jnp.asarray(sp), jnp.asarray(aids),
+            gmask, jnp.asarray(grows))
         self.cache = cache
         # one bulk D2H + tolist per window: acceptance and replay run on
         # Python scalars, not per-token np conversions
@@ -3630,9 +3824,20 @@ class InferenceEngine:
             # empty rows are deterministic proposers (one-hot q)
             onehot[r] = depths[i] <= 0
 
+        grammar = None
+        if self._gram_table is not None:
+            gmask_d, gtrans_d, _ = self._grammar_args()
+            grows0 = np.zeros((B,), np.int32)
+            for r, i in enumerate(rows):
+                gs = self._gram_slots[i]
+                if gs is not None:
+                    grows0[r] = gs.base + gs.state
+            grammar = (gmask_d, gtrans_d, jnp.asarray(grows0))
+
         if k_exec > 0:
             props, dlogits = runner.propose(
-                slot_map, last, sp, temps, draft_rows, k_exec)
+                slot_map, last, sp, temps, draft_rows, k_exec,
+                grammar=grammar)
             if k_exec < W - 1:
                 dlogits = jnp.pad(
                     dlogits, ((0, 0), (0, W - 1 - k_exec), (0, 0)))
@@ -3644,19 +3849,26 @@ class InferenceEngine:
             dlogits = jnp.zeros((B, W - 1, self.md.arch.vocab_size),
                                 jnp.float32)
 
+        grows = np.zeros((B, W), np.int32)
         prop_len = np.zeros((B,), np.int32)
         for r, i in enumerate(rows):
+            # masked drafting already keeps constrained proposals valid;
+            # the clip is load-bearing for the n-gram fallback rows (and
+            # defensive for the draft rows)
+            proposals[i] = self._truncate_for_grammar(i, proposals[i])
             window = [last[r]] + proposals[i]
             toks[r, : len(window)] = window
             tl[r] = len(window)
             prop_len[r] = len(proposals[i])
+            grows[r] = self._gram_rows_for(i, proposals[i], W)
 
         keys = runner.gather_keys(slot_map)
+        gmask_v, _, _ = self._grammar_args()
         cache, out, n_emit, lps, new_keys = self._verify_accept_fn(W)(
             self.params, self.cache, jnp.asarray(toks), jnp.asarray(tl),
             jnp.asarray(tables), jnp.asarray(sp), jnp.asarray(aids),
             dlogits, jnp.asarray(prop_len), jnp.asarray(temps),
-            jnp.asarray(onehot), keys)
+            jnp.asarray(onehot), keys, gmask_v, jnp.asarray(grows))
         self.cache = cache
         runner.scatter_keys(slot_map, new_keys)
         out = np.asarray(out).tolist()
@@ -3720,6 +3932,14 @@ class InferenceEngine:
         req = slot.request
         assert req is not None
         req.output_tokens.append(token)
+        gs = self._gram_slots[slot_idx]
+        if gs is not None:
+            # host mirror of the device grammar state: the fused/async
+            # scans advanced it on-device already, so no dirty-mark —
+            # this keeps the mirror exact for the next sync upload,
+            # preemption replay, and speculation walks
+            gs.advance(token)
+            self._gram_state[slot_idx] = gs.base + gs.state
         ngram_idx = self._ngram_idx.get(slot_idx)
         if ngram_idx is not None:
             ngram_idx.append(token)

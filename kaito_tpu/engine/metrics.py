@@ -257,6 +257,54 @@ class Registry:
         return "\n".join(lines) + "\n"
 
 
+class _GrammarCollector:
+    """Gated ``kaito:grammar_*`` family (docs/structured-output.md).
+
+    Emits nothing until the grammar cache has served a constrained
+    request (``GrammarCache.touched``), so a deployment that never
+    sends ``response_format``/``tools`` keeps a byte-identical
+    exposition — the same discipline as the KV-pool and adapter
+    families, but gated at scrape time because the first constrained
+    request can arrive long after metric registration."""
+
+    def __init__(self, engine):
+        self.engine = engine
+
+    def collect(self) -> Iterable[str]:
+        cache = getattr(self.engine, "grammar_cache", None)
+        if cache is None or not cache.touched:
+            return
+        name = "kaito:grammar_compile_seconds"
+        yield (f"# HELP {name} Schema/regex -> token-mask grammar "
+               f"compile latency")
+        yield f"# TYPE {name} histogram"
+        counts = list(cache.compile_bucket_counts)
+        cum = 0
+        for i, edge in enumerate(cache.compile_buckets):
+            cum += counts[i]
+            yield f'{name}_bucket{{le="{_fmt(edge)}"}} {cum}'
+        cum += counts[-1]
+        yield f'{name}_bucket{{le="+Inf"}} {cum}'
+        yield f"{name}_sum {_fmt(cache.compile_sum_seconds)}"
+        yield f"{name}_count {cache.compile_count}"
+        stats = cache.stats()
+        for key, help_ in (
+                ("grammar_cache_hits_total",
+                 "Constrained requests served a precompiled grammar"),
+                ("grammar_cache_misses_total",
+                 "Constrained requests that compiled a new grammar"),
+                ("grammar_cache_evictions_total",
+                 "Grammars LRU-evicted from the compile cache"),
+                ("grammar_requests_total",
+                 "Requests admitted with a decoding grammar attached"),
+                ("grammar_cache_entries",
+                 "Grammars resident in the compile cache")):
+            mname = f"kaito:{key}"
+            yield f"# HELP {mname} {help_}"
+            yield f"# TYPE {mname} gauge"
+            yield f"{mname} {_fmt(stats.get(key, 0))}"
+
+
 class EngineMetrics:
     """The engine's metric family (names mirror vLLM's so the KEDA
     scaler/EPP configs translate 1:1)."""
@@ -526,6 +574,10 @@ class EngineMetrics:
             Gauge("kaito:spec_depth",
                   "Mean adaptive speculation depth over active slots", r,
                   fn=lambda: getattr(engine, "spec_depth", 0.0))
+            if getattr(engine, "grammar_cache", None) is not None:
+                # structured output (docs/structured-output.md): the
+                # collector itself gates on first constrained use
+                r.register(_GrammarCollector(engine))
             # live-calibrated break-even constants (0 until the first
             # observed transfer / prefill provides a sample)
             Gauge("kaito:pd_measured_net_bytes_s",

@@ -217,6 +217,114 @@ def parse_message(text: str, reasoning: bool = True,
                          tool_calls=calls)
 
 
+def parse_forced_tool_call(text: str) -> ParsedMessage:
+    """Parse a grammar-forced tool call (docs/structured-output.md):
+    with ``tool_choice`` required/named the generation is constrained
+    to the pure-JSON envelope ``{"name": ..., "arguments": {...}}``, so
+    extraction is a direct json.loads — no wire-format scan, no
+    fallback chain.  A parse failure here would mean the grammar let an
+    invalid envelope through; surface it as plain content rather than
+    500 the request."""
+    try:
+        obj = json.loads(text)
+    except json.JSONDecodeError:
+        return ParsedMessage(content=text)
+    entry = _tool_call_entry(obj) if isinstance(obj, dict) else None
+    if entry is None:
+        return ParsedMessage(content=text)
+    return ParsedMessage(content="", tool_calls=[entry])
+
+
+def tool_call_deltas(calls: list[dict]) -> list[dict]:
+    """OpenAI streaming shape for a finished set of tool calls: one
+    opening delta per call (id + name + empty arguments) followed by
+    one arguments delta — what a client-side accumulator expects."""
+    out = []
+    for i, c in enumerate(calls):
+        fn = c["function"]
+        out.append({"index": i, "id": c["id"], "type": "function",
+                    "function": {"name": fn["name"], "arguments": ""}})
+        if fn["arguments"]:
+            out.append({"index": i,
+                        "function": {"arguments": fn["arguments"]}})
+    return out
+
+
+class StreamingToolCallParser:
+    """Incremental ``tool_calls`` deltas for a grammar-forced call.
+
+    The forced envelope is canonical compact JSON with a fixed property
+    order — ``{"name":"...","arguments":{...}}`` — so the name is
+    extractable as soon as its closing quote lands, and everything
+    between ``"arguments":`` and the envelope's closing brace streams
+    through as argument bytes the moment it arrives.  ``feed`` returns
+    the deltas unlocked by each text increment; ``finish`` flushes
+    whatever a truncated generation left."""
+
+    _NAME_RE = re.compile(r'^\s*\{"name":"((?:[^"\\]|\\.)*)"\s*,'
+                          r'\s*"arguments":')
+
+    def __init__(self):
+        self.buf = ""
+        self.call_id = f"call_{uuid.uuid4().hex[:24]}"
+        self._args_from: Optional[int] = None  # buf offset of args value
+        self._sent_args = 0                    # arg chars already emitted
+        self._done = False
+
+    def feed(self, text_delta: str) -> list[dict]:
+        self.buf += text_delta
+        out: list[dict] = []
+        if self._args_from is None:
+            m = self._NAME_RE.match(self.buf)
+            if not m:
+                return out
+            self._args_from = m.end()
+            name = json.loads(f'"{m.group(1)}"')
+            out.append({"index": 0, "id": self.call_id,
+                        "type": "function",
+                        "function": {"name": name, "arguments": ""}})
+        if not self._done:
+            chunk = self._pending_args()
+            if chunk:
+                self._sent_args += len(chunk)
+                out.append({"index": 0,
+                            "function": {"arguments": chunk}})
+        return out
+
+    def finish(self) -> list[dict]:
+        return self.feed("")
+
+    def _pending_args(self) -> str:
+        """Argument chars that are safely part of the value: scan from
+        the args offset tracking brace depth and string state; the
+        brace that returns the ENVELOPE to depth 0 is the terminator
+        and never streams."""
+        s = self.buf[self._args_from:]
+        depth, in_str, esc = 1, False, False   # envelope brace is open
+        for j, ch in enumerate(s):
+            if esc:
+                esc = False
+                continue
+            if in_str:
+                if ch == "\\":
+                    esc = True
+                elif ch == '"':
+                    in_str = False
+                continue
+            if ch == '"':
+                in_str = True
+            elif ch in "{[":
+                depth += 1
+            elif ch in "}]":
+                depth -= 1
+                if depth == 0:
+                    self._done = True
+                    return s[self._sent_args:j]
+        # mid-value: emit everything except a possible trailing escape
+        end = len(s) - 1 if esc else len(s)
+        return s[self._sent_args:end]
+
+
 def _tool_specs(tools: list[dict]) -> list[dict]:
     specs = []
     for t in tools or []:

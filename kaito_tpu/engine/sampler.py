@@ -118,7 +118,7 @@ def apply_penalties(logits: jax.Array, state: SamplingState,
 def spec_verify_sample(target_logits: jax.Array, draft_logits: jax.Array,
                        proposal: jax.Array, prop_len: jax.Array,
                        temperature: jax.Array, onehot_q: jax.Array,
-                       keys: jax.Array):
+                       keys: jax.Array, grammar_rows=None):
     """Leviathan-style speculative verification: accept a prefix of the
     proposal, then draw one token from the residual distribution — the
     emitted stream is distribution-identical to sampling the target
@@ -132,7 +132,14 @@ def spec_verify_sample(target_logits: jax.Array, draft_logits: jax.Array,
     proposal      [B, K] int32; prop_len [B] valid proposal tokens;
     temperature   [B]; onehot_q [B] bool (n-gram / deterministic rows);
     keys          [B, 2] uint32 PRNG keys (speculation-private — the
-                  engine's SamplingState keys are never consumed here).
+                  engine's SamplingState keys are never consumed here);
+    grammar_rows  optional [B, W, V] fp32 of 0 / -inf grammar masks per
+                  window position (a shape-mismatched placeholder
+                  statically disables the path).  The verify
+                  distribution renormalizes under the mask — softmax of
+                  masked logits IS the renormalized conditional — so
+                  constrained rows keep speculating instead of falling
+                  back to plain decode.
 
     Returns (out [B, W] int32, n_emit [B] int32, lps [B, W] f32,
     new_keys [B, 2]).  out[:, :n_emit] are the emitted tokens (accepted
@@ -142,10 +149,13 @@ def spec_verify_sample(target_logits: jax.Array, draft_logits: jax.Array,
     """
     B, W, V = target_logits.shape
     K = W - 1
+    masked_logits = target_logits
+    if grammar_rows is not None and grammar_rows.shape == target_logits.shape:
+        masked_logits = target_logits + grammar_rows
     greedy_row = temperature <= 0.0
     temp = jnp.maximum(temperature, 1e-6)[:, None, None]
-    p_soft = jax.nn.softmax(target_logits / temp, axis=-1)
-    p_hot = jax.nn.one_hot(jnp.argmax(target_logits, axis=-1), V,
+    p_soft = jax.nn.softmax(masked_logits / temp, axis=-1)
+    p_hot = jax.nn.one_hot(jnp.argmax(masked_logits, axis=-1), V,
                            dtype=p_soft.dtype)
     p = jnp.where(greedy_row[:, None, None], p_hot, p_soft)     # [B, W, V]
     q_soft = jax.nn.softmax(draft_logits / temp, axis=-1)
@@ -206,11 +216,17 @@ def spec_verify_sample(target_logits: jax.Array, draft_logits: jax.Array,
 
 
 def sample(logits: jax.Array, state: SamplingState,
-           counts=None, prompt_seen=None) -> tuple[jax.Array, SamplingState]:
+           counts=None, prompt_seen=None,
+           grammar_rows=None) -> tuple[jax.Array, SamplingState]:
     """Sample one token per row. logits: [B, V] fp32; counts: optional
     [B, V] output-token histogram for penalties (a shape-mismatched
     placeholder statically disables the penalty path, so penalty-free
-    engines never allocate or touch [B, V] state).
+    engines never allocate or touch [B, V] state); grammar_rows:
+    optional [B, V] fp32 of 0 / -inf constrained-decoding masks,
+    pre-gathered per slot (same placeholder discipline — grammar-free
+    engines compile this path away entirely).  The mask lands before
+    temperature/top-k/top-p so greedy, categorical and nucleus paths
+    all honor it; unconstrained rows carry an all-zero row (no-op).
 
     The sort-based top-k/top-p masking and the categorical draw are
     gated behind ``lax.cond`` on what the batch actually requests: a
@@ -221,6 +237,8 @@ def sample(logits: jax.Array, state: SamplingState,
     B, V = logits.shape
     if counts is not None and counts.shape == logits.shape:
         logits = apply_penalties(logits, state, counts, prompt_seen)
+    if grammar_rows is not None and grammar_rows.shape == logits.shape:
+        logits = logits + grammar_rows
     temp = jnp.maximum(state.temperature, 1e-6)[:, None]
     scaled = logits / temp
 

@@ -1045,6 +1045,67 @@ class OpenAIHandler(BaseHTTPRequestHandler):
 
     # ---------------- generation ----------------
 
+    def _stream_tool_calls(self, st, req, base, body, forced: bool):
+        """SSE tail for chat requests with tools (the role delta is
+        already sent).  Forced calls (tool_choice required/named) are
+        grammar-constrained to the JSON envelope, so name + argument
+        bytes stream incrementally as they decode; auto mode buffers to
+        end-of-generation and then emits EITHER content or tool_calls
+        deltas — a client accumulator must never see both interleaved."""
+        from kaito_tpu.engine.parsers import (
+            StreamingToolCallParser,
+            parse_message,
+            tool_call_deltas,
+        )
+
+        def send(delta, finish=None):
+            chunk = dict(base)
+            chunk["choices"] = [{"index": 0, "delta": delta,
+                                 "finish_reason": finish}]
+            self._sse_send(chunk)
+
+        ids: list[int] = []
+        finish = "stop"
+        if forced:
+            parser = StreamingToolCallParser()
+            sent = ""
+            for tok in req.stream():
+                ids.append(tok)
+                text = st.engine.tokenizer.decode(ids)
+                if text.endswith("�"):
+                    continue  # mid-codepoint; wait for more bytes
+                delta_text, sent = text[len(sent):], text
+                for d in parser.feed(delta_text):
+                    send({"tool_calls": [d]})
+            tail = st.engine.tokenizer.decode(ids)[len(sent):]
+            for d in parser.feed(tail) + parser.finish():
+                send({"tool_calls": [d]})
+            finish = "tool_calls"
+        else:
+            for tok in req.stream():
+                ids.append(tok)
+            text = st.engine.tokenizer.decode(ids)
+            parsed = parse_message(
+                text,
+                reasoning=bool(getattr(st.engine.md,
+                                       "reasoning_parser", None)),
+                tools=True,
+                tool_mode=getattr(st.engine.md, "tool_call_parser", ""))
+            if parsed.content:
+                send({"content": parsed.content})
+            if parsed.tool_calls:
+                for d in tool_call_deltas(parsed.tool_calls):
+                    send({"tool_calls": [d]})
+                finish = "tool_calls"
+            else:
+                finish = req.finish_reason or "stop"
+        send({}, finish=finish)
+        self._sse_end()
+        st.metrics.observe_request(req)
+        st.slo.observe_request(req)
+        st.limiter.note_tokens(
+            req.tenant, len(req.prompt_tokens) + len(req.output_tokens))
+
     def _completions(self, chat: bool):
         st = self.state
         body = self._read_body()
@@ -1081,21 +1142,68 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                         headers={"Retry-After": retry_after})
             return
 
+        # grammar-constrained decoding intake (docs/structured-output.md):
+        # response_format + tools/tool_choice validate and COMPILE here,
+        # in the request thread, before admission — the step thread only
+        # ever sees a finished CompiledGrammar.  Structural mistakes are
+        # 400; a well-formed schema the compiler rejects is 422.
+        from kaito_tpu.engine.grammar import (
+            GrammarError, GrammarSpec, canonical_schema,
+            spec_from_response_format, tool_envelope_schema,
+        )
+
+        tools = body.get("tools")
+        tool_choice = body.get("tool_choice")
+        if not chat and (tools is not None or tool_choice is not None):
+            return self._error(400, "'tools' and 'tool_choice' are only "
+                                    "supported on /v1/chat/completions")
+        forced_tools = False
+        grammar_spec = None
+        use_tools = False
         try:
             if chat:
                 messages = body.get("messages")
                 if not isinstance(messages, list) or not messages:
                     return self._error(400, "'messages' must be a non-empty list")
-                tools = body.get("tools")
-                if tools:
-                    if not isinstance(tools, list) or not all(
+                if tools is not None:
+                    if not isinstance(tools, list) or not tools or not all(
                             isinstance(t, dict) for t in tools):
                         return self._error(
-                            400, "'tools' must be a list of tool objects")
-                    if body.get("stream"):
+                            400, "'tools' must be a non-empty list of "
+                                 "tool objects")
+                if tool_choice is not None and not tools:
+                    return self._error(
+                        400, "'tool_choice' requires 'tools'")
+                if tools:
+                    choice = tool_choice if tool_choice is not None \
+                        else "auto"
+                    named = None
+                    if isinstance(choice, dict):
+                        named = (choice.get("function") or {}).get("name")
+                        if choice.get("type") != "function" or not named:
+                            return self._error(
+                                400, "'tool_choice' object must be "
+                                     '{"type": "function", "function": '
+                                     '{"name": ...}}')
+                    elif choice not in ("auto", "none", "required"):
                         return self._error(
-                            400, "tool calls are not supported with "
-                                 "streaming yet")
+                            400, f"unknown tool_choice {choice!r}")
+                    if named is not None or choice == "required":
+                        # forced call: constrain generation to the pure
+                        # JSON envelope and parse it directly
+                        try:
+                            env = tool_envelope_schema(
+                                tools,
+                                names=[named] if named else None)
+                        except GrammarError as e:
+                            return self._error(400, str(e))
+                        grammar_spec = GrammarSpec(
+                            "json_schema", canonical_schema(env))
+                        forced_tools = True
+                        use_tools = True
+                    elif choice == "auto":
+                        use_tools = True
+                if use_tools:
                     # advertise tools in the model's own call wire
                     # format (the preset's tool_call_parser mode);
                     # parse_message reads it back out. Merge into an
@@ -1125,6 +1233,33 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                 if not isinstance(prompt, str) or prompt == "":
                     return self._error(400, "'prompt' must be a non-empty string")
                 prompt_text = prompt
+
+            rf = body.get("response_format")
+            if rf is not None:
+                if grammar_spec is not None:
+                    return self._error(
+                        400, "'response_format' cannot be combined with "
+                             "a forced tool_choice (both constrain the "
+                             "output grammar)")
+                try:
+                    grammar_spec = spec_from_response_format(rf)
+                except GrammarError as e:
+                    return self._error(400, str(e))
+            grammar = None
+            if grammar_spec is not None:
+                if not getattr(st.engine.cfg, "structured_output", True):
+                    return self._error(
+                        400, "structured output is disabled on this "
+                             "server (structured_output=false)",
+                        "structured_output_disabled")
+                try:
+                    grammar = st.engine.grammar_cache.get(
+                        grammar_spec, st.engine.tokenizer)
+                except GrammarError as e:
+                    # well-formed request, uncompilable grammar (state
+                    # cap, tokenizer dead end, unsupported construct)
+                    return self._error(422, str(e),
+                                       "invalid_grammar_error")
 
             # logprobs: per-generated-token log p of the chosen token
             # under the model distribution; top-k ALTERNATIVES are not
@@ -1174,6 +1309,7 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                 # vLLM extra-param parity: benchmarking/tests pin exact
                 # generation lengths with ignore_eos
                 ignore_eos=bool(body.get("ignore_eos", False)),
+                grammar=grammar,
             )
             # per-request deadline (seconds); 0/absent falls back to the
             # server default (cfg.request_timeout_s).  Expired requests
@@ -1319,6 +1455,9 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                 first["choices"] = [{"index": 0, "delta": {"role": "assistant"},
                                      "finish_reason": None}]
                 self._sse_send(first)
+            if chat and use_tools:
+                return self._stream_tool_calls(st, req, base, body,
+                                               forced_tools)
             sent_text = ""
             ids: list[int] = []
             stopped = False
@@ -1422,14 +1561,23 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                 # tool-call + reasoning post-processing, gated
                 # per-preset exactly like the reference's parser flags
                 # (generator.go)
-                from kaito_tpu.engine.parsers import parse_message
+                from kaito_tpu.engine.parsers import (
+                    parse_forced_tool_call,
+                    parse_message,
+                )
 
-                parsed = parse_message(
-                    text,
-                    reasoning=bool(getattr(st.engine.md,
-                                           "reasoning_parser", None)),
-                    tools=bool(body.get("tools")),
-                    tool_mode=getattr(st.engine.md, "tool_call_parser", ""))
+                if forced_tools:
+                    # grammar-forced envelope: direct JSON parse, no
+                    # wire-format scan (docs/structured-output.md)
+                    parsed = parse_forced_tool_call(text)
+                else:
+                    parsed = parse_message(
+                        text,
+                        reasoning=bool(getattr(st.engine.md,
+                                               "reasoning_parser", None)),
+                        tools=use_tools,
+                        tool_mode=getattr(st.engine.md,
+                                          "tool_call_parser", ""))
                 message = {"role": "assistant", "content": parsed.content}
                 if parsed.reasoning_content is not None:
                     message["reasoning_content"] = parsed.reasoning_content
@@ -1602,6 +1750,12 @@ def load_config_file(cfg: EngineConfig, path: str) -> EngineConfig:
         "dtype": "dtype", "kv-cache-dtype": "kv_dtype",
         "quantization": "quantization",
         "seed": "seed", "port": "port",
+        "structured-output": "structured_output",
+        "structured_output": "structured_output",
+        "grammar-cache-entries": "grammar_cache_entries",
+        "grammar_cache_entries": "grammar_cache_entries",
+        "grammar-max-states": "grammar_max_states",
+        "grammar_max_states": "grammar_max_states",
     }
     for k, v in (section or {}).items():
         if k in alias and v is not None:
@@ -1763,6 +1917,22 @@ def main(argv=None):
                     help="dump a request's span tree to the log when its "
                          "end-to-end latency crosses this (0 = off); see "
                          "docs/observability.md")
+    ap.add_argument("--no-structured-output", dest="structured_output",
+                    action="store_false", default=os.environ.get(
+                        "KAITO_STRUCTURED_OUTPUT", "1") != "0",
+                    help="reject response_format / forced tool_choice "
+                         "with a typed 400 (docs/structured-output.md); "
+                         "on by default and pay-per-use")
+    ap.add_argument("--grammar-cache-entries", type=int,
+                    default=int(os.environ.get(
+                        "KAITO_GRAMMAR_CACHE_ENTRIES", "64")),
+                    help="compiled-schema LRU entries "
+                         "(docs/structured-output.md cache sizing)")
+    ap.add_argument("--grammar-max-states", type=int,
+                    default=int(os.environ.get(
+                        "KAITO_GRAMMAR_MAX_STATES", "512")),
+                    help="DFA state cap per grammar; each state costs "
+                         "O(vocab) bytes in the packed device mask table")
     args = ap.parse_args(argv)
 
     import jax
@@ -1816,6 +1986,9 @@ def main(argv=None):
         kv_shed_threshold=args.kv_shed_threshold,
         kv_import_retries=args.kv_import_retries,
         slow_request_threshold_s=args.slow_request_threshold_s,
+        structured_output=args.structured_output,
+        grammar_cache_entries=args.grammar_cache_entries,
+        grammar_max_states=args.grammar_max_states,
     )
     if args.kaito_config_file:
         cfg = load_config_file(cfg, args.kaito_config_file)

@@ -383,15 +383,23 @@ class DraftRunner:
 
             @partial(jax.jit, donate_argnums=(1,))
             def propose(params, cache, tokens, positions, page_tables,
-                        active, temperature, keys):
+                        active, temperature, keys, gmask, gtrans, grows):
                 temp = jnp.maximum(temperature, 1e-6)[:, None]
                 rnd = temperature > 0.0
 
                 def step(carry, _):
-                    cache, toks, pos, keys = carry
+                    cache, toks, pos, keys, gr = carry
                     cache, logits = model.decode(params, cache, toks, pos,
                                                  page_tables, active=active)
                     logits = logits.astype(jnp.float32)
+                    if gmask.shape[0] > 1:
+                        # grammar-constrained rows propose under the
+                        # mask; the returned logits are then the MASKED
+                        # q — exactly the distribution the tokens were
+                        # drawn from, which is what Leviathan rejection
+                        # sampling needs (unconstrained rows gather the
+                        # reserved all-zero row: a no-op)
+                        logits = logits + gmask[gr]
                     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
                     def draw(operands):
@@ -411,10 +419,12 @@ class DraftRunner:
                         jnp.any(rnd), draw,
                         lambda o: (o[0], greedy), (keys, logits / temp))
                     nxt = jnp.where(rnd, sampled, greedy)
-                    return (cache, nxt, pos + 1, keys), (nxt, logits)
+                    if gmask.shape[0] > 1:
+                        gr = gtrans[gr, nxt]
+                    return (cache, nxt, pos + 1, keys, gr), (nxt, logits)
 
-                (cache, _, _, keys), (toks, logits) = jax.lax.scan(
-                    step, (cache, tokens, positions, keys), None,
+                (cache, _, _, keys, _), (toks, logits) = jax.lax.scan(
+                    step, (cache, tokens, positions, keys, grows), None,
                     length=k_exec)
                 # scan stacks [K, B] / [K, B, V]; row-major for the host
                 return (cache, toks.T,
@@ -425,18 +435,28 @@ class DraftRunner:
         return fn
 
     def propose(self, slot_map, last_tokens, positions, temps, active,
-                k_exec: int):
+                k_exec: int, grammar=None):
         """Run the K-step draft scan over the compact verify batch.
 
         slot_map: [B] engine-slot index per row (-1 = padding);
         active: [B] bool — rows that actually draft-propose this round
-        (others ride along masked to the null page).  Returns
-        (proposals np [B, k_exec] int32, draft_logits device
+        (others ride along masked to the null page).  ``grammar`` is
+        None or an engine-provided (gmask, gtrans, grows) triple —
+        packed mask/transition tables plus each row's starting table
+        row — that keeps constrained rows proposing only
+        grammar-valid tokens (returned logits become the masked q).
+        Returns (proposals np [B, k_exec] int32, draft_logits device
         [B, k_exec, V] f32).  The per-slot speculation keys for active
         rows advance in place.
         """
         idx = np.maximum(slot_map, 0)
         keys = jnp.asarray(self.keys)[jnp.asarray(idx)]
+        if grammar is None:
+            gmask = jnp.zeros((1, 1), jnp.float32)
+            gtrans = jnp.zeros((1, 1), jnp.int32)
+            grows = jnp.zeros((len(slot_map),), jnp.int32)
+        else:
+            gmask, gtrans, grows = grammar
         cache, toks, dlogits, new_keys = self._propose_fn(k_exec)(
             self.params, self.cache,
             jnp.asarray(last_tokens, jnp.int32),
@@ -444,7 +464,7 @@ class DraftRunner:
             jnp.asarray(self.tables[idx]),
             jnp.asarray(active, bool),
             jnp.asarray(temps, jnp.float32),
-            keys)
+            keys, gmask, gtrans, grows)
         self.cache = cache
         # enqueue the proposal readback before the key scatter so the
         # D2H copy rides the device stream alongside the scatter

@@ -100,6 +100,60 @@ def parse_adapters_annotation(text: str) -> Optional[dict]:
             "allowlist": [str(p) for p in allowlist]}
 
 
+def parse_structured_output_annotation(text: str) -> Optional[dict]:
+    """Parse the ``kaito-tpu.io/structured-output`` Workspace
+    annotation (docs/structured-output.md).  Empty input returns None —
+    the server keeps its defaults (structured output ON).  Accepts a
+    bare boolean string (``"false"`` turns the surface off fleet-wide)
+    or a JSON object sizing the grammar compile cache:
+
+    .. code-block:: json
+
+        {"enabled": true, "cache_entries": 128, "max_states": 1024}
+
+    Raises ValueError on a malformed document; the workspace controller
+    calls this at plan time so a bad annotation becomes a PlanFailed
+    condition instead of a crash-looping pod (the adapters-annotation
+    precedent).  jax-free on purpose: the controller imports it."""
+    text = (text or "").strip()
+    if not text:
+        return None
+    lowered = text.lower()
+    if lowered in ("true", "1", "on", "enabled"):
+        return {"enabled": True, "cache_entries": None, "max_states": None}
+    if lowered in ("false", "0", "off", "disabled"):
+        return {"enabled": False, "cache_entries": None, "max_states": None}
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError as e:
+        raise ValueError(
+            f"structured-output config is not valid JSON: {e}") from None
+    if not isinstance(doc, dict):
+        raise ValueError("structured-output config must be a boolean "
+                         "string or a JSON object")
+    unknown = set(doc) - {"enabled", "cache_entries", "max_states"}
+    if unknown:
+        raise ValueError(f"structured-output config has unknown "
+                         f"field(s): {sorted(unknown)}")
+    enabled = doc.get("enabled", True)
+    if not isinstance(enabled, bool):
+        raise ValueError("structured-output config: enabled must be a "
+                         "boolean")
+    out = {"enabled": enabled, "cache_entries": None, "max_states": None}
+    for field, lo in (("cache_entries", 1), ("max_states", 2)):
+        if field not in doc:
+            continue
+        v = doc[field]
+        if isinstance(v, bool) or not isinstance(v, int):
+            raise ValueError(
+                f"structured-output config: {field} must be an integer")
+        if v < lo:
+            raise ValueError(
+                f"structured-output config: {field} must be >= {lo}")
+        out[field] = v
+    return out
+
+
 def coordinator_address(workspace_name: str, namespace: str) -> str:
     """Pod-0 DNS via the headless service — same convention the
     reference uses for the Ray leader (``pkg/utils/common.go:229``),
@@ -188,6 +242,20 @@ def build_engine_command(
         if lora["allowlist"]:
             args += ["--adapter-source-allowlist",
                      ",".join(lora["allowlist"])]
+    # structured output (docs/structured-output.md): the controller
+    # validated the document at plan time (PlanFailed on malformed);
+    # rendering turns it into the grammar-cache flags.  Enabled is the
+    # server default, so only the off switch and explicit sizes render
+    # — an absent annotation keeps the pod command byte-identical.
+    so = parse_structured_output_annotation(
+        ws.metadata.annotations.get("kaito-tpu.io/structured-output", ""))
+    if so is not None:
+        if not so["enabled"]:
+            args += ["--no-structured-output"]
+        if so["cache_entries"] is not None:
+            args += ["--grammar-cache-entries", str(so["cache_entries"])]
+        if so["max_states"] is not None:
+            args += ["--grammar-max-states", str(so["max_states"])]
     if config_file:
         args += ["--kaito-config-file", config_file]
     if adapters_dir:

@@ -101,6 +101,8 @@ _ENGINE_COUNTERS = {
     "kaito:adapter_loads_total": "adapter_loads_total",
     "kaito:adapter_evictions_total": "adapter_evictions_total",
     "kaito:adapter_hits_total": "adapter_hits_total",
+    "kaito:grammar_cache_hits_total": "grammar_hits_total",
+    "kaito:grammar_cache_misses_total": "grammar_misses_total",
 }
 # EPP / router front series (arrival side of the same CR).  The
 # received counter keeps ticking even with ZERO backends — it is the
@@ -632,6 +634,7 @@ class FleetTelemetry:
                 "host_kv_evictions_total",
                 "adapter_loads_total", "adapter_evictions_total",
                 "adapter_hits_total",
+                "grammar_hits_total", "grammar_misses_total",
                 "forwarded_total", "received_total"]
         # per-tenant counters carry the tenant in the key itself
         # ("tenant_shed_total:acme"), so rate whatever both samples have
@@ -761,6 +764,8 @@ class FleetTelemetry:
         acc = rate("spec_accepted_rate")
         hkv_hit = rate("host_kv_hits_rate")
         hkv_miss = rate("host_kv_misses_rate")
+        gr_hit = rate("grammar_hits_rate")
+        gr_miss = rate("grammar_misses_rate")
         agg = {
             "replicas_reporting": float(len(replicas)),
             "queue_sum": fold("waiting", "sum"),
@@ -798,6 +803,13 @@ class FleetTelemetry:
             "adapter_loads_rate": rate("adapter_loads_rate"),
             "adapter_evictions_rate": rate("adapter_evictions_rate"),
             "adapter_hits_rate": rate("adapter_hits_rate"),
+            # structured output (docs/structured-output.md): fraction
+            # of constrained requests served a precompiled grammar —
+            # a low rate cluster-wide means the schema working set
+            # exceeds --grammar-cache-entries
+            "grammar_cache_hit_rate": (
+                gr_hit / (gr_hit + gr_miss)
+                if gr_hit + gr_miss > 0 else 0.0),
         }
         if epps:
             agg["arrival_rate"] = sum(
@@ -1026,6 +1038,10 @@ class FleetTelemetry:
               "Fleet rate of requests served by an already-resident "
               "adapter", r,
               labels=("kind", "name"), fn=family("adapter_hits_rate"))
+        Gauge("kaito:fleet_grammar_cache_hit_rate",
+              "Fleet grammar compile-cache hit ratio for constrained "
+              "requests (rate-weighted)", r,
+              labels=("kind", "name"), fn=family("grammar_cache_hit_rate"))
 
         def tenant_family(prefix):
             def _fn():
