@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: all native unit-test unit-test-fast unit-test-slow engine-test rag-test chaos kvq wquant kvpool lora structured obs slo fleet autoscale spec qos asyncloop bench serve manager epp clean
+.PHONY: all native unit-test unit-test-fast unit-test-slow engine-test rag-test chaos kvq wquant kvpool lora structured obs slo fleet autoscale spec qos asyncloop prefill bench serve manager epp clean
 
 all: native
 
@@ -82,7 +82,7 @@ structured:
 obs:
 	$(PYTHON) -m pytest tests/test_tracing.py tests/test_metrics_format.py \
 	  tests/test_slo.py tests/test_controllers.py tests/test_fleet.py \
-	  -q -m "not slow"
+	  tests/test_prefill_pack.py -q -m "not slow"
 
 # SLO watchdog suite alone (docs/observability.md "Control plane")
 slo:
@@ -123,6 +123,17 @@ asyncloop:
 	$(PYTHON) -m pytest tests/test_async_dispatch.py -q
 	KAITO_ASYNC_DISPATCH=1 $(PYTHON) -m pytest \
 	  tests/test_async_dispatch.py tests/test_decode_run_ahead.py -q
+
+# packed multi-sequence prefill (docs/prefill.md): token-budget
+# scheduler + segment-packed dispatch bit-equivalence, packed flash
+# kernel segment-mask parity, then the chunked-prefill engine tier
+# once more with KAITO_PREFILL_PACK=8 forced so the packed path can't
+# rot behind its auto default
+prefill:
+	$(PYTHON) -m pytest tests/test_prefill_pack.py \
+	  tests/test_flash_prefill.py -q
+	KAITO_PREFILL_PACK=8 $(PYTHON) -m pytest \
+	  tests/test_chunked_prefill.py -q
 
 bench:
 	$(PYTHON) bench.py

@@ -399,6 +399,16 @@ def orchestrate(args):
             merged.setdefault("errors", []).append(res["error"])
         save_partial()
 
+    # --- phase: packed-prefill burst (tokens/dispatch + TTFT, pack
+    # on-vs-off; docs/prefill.md) ---
+    if not args.skip_prefill_bench and remaining() > 90:
+        res = run_phase("prefill_burst", passthru, min(remaining(), 400.0))
+        if "error" not in res:
+            merged.update(res)
+        else:
+            merged.setdefault("errors", []).append(res["error"])
+        save_partial()
+
     # --- phase: int8 8B-class serving (TPU only) ---
     if on_tpu and not args.skip_int8_8b and not args.quant \
             and remaining() > 150:
@@ -1205,6 +1215,88 @@ def phase_prefix(args):
     print(json.dumps(out), flush=True)
 
 
+def phase_prefill_burst(args):
+    """Concurrent-arrival prefill burst: N short+long prompts submitted
+    at once, TTFT p50/p99 and prompt tokens per prefill dispatch, pack
+    ON vs OFF (docs/prefill.md).  The tokens/dispatch ratio is the
+    direct proxy for the packing win — serial runs one staged prompt
+    per round regardless of budget headroom."""
+    jax = _init_jax(force_cpu=args.force_cpu)
+
+    from kaito_tpu.engine.config import EngineConfig
+    from kaito_tpu.engine.engine import InferenceEngine, SamplingParams
+
+    on_tpu = jax.devices()[0].platform not in ("cpu",)
+    model_name = args.model or ("phi-4-mini-instruct" if on_tpu
+                                else "tiny-llama-test")
+    if on_tpu:
+        short, long_, max_len, dtype = 256, 1024, 2048, "bfloat16"
+        buckets, n_reqs, budget = (256, 512, 1024, 2048), 16, 2048
+    else:
+        short, long_, max_len, dtype = 24, 96, 256, "float32"
+        buckets, n_reqs, budget = (32, 64, 128), 8, 256
+
+    def run(pack):
+        cfg = EngineConfig(model=model_name, dtype=dtype, kv_dtype=dtype,
+                           max_num_seqs=n_reqs, max_model_len=max_len,
+                           prefill_buckets=buckets, page_size=16,
+                           max_prefill_tokens=budget,
+                           enable_prefix_caching=False,
+                           prefill_pack=pack, seed=0)
+        eng = InferenceEngine(cfg)
+        eng.start()
+        try:
+            vocab = eng.md.arch.vocab_size
+            p = SamplingParams(max_tokens=4, temperature=0.0,
+                               ignore_eos=True)
+            rng = np.random.RandomState(11)
+            prompts = [rng.randint(
+                1, min(vocab, 255),
+                (long_ if i % 3 == 0 else short,)).tolist()
+                for i in range(n_reqs)]
+            subs, reqs = [], []
+            for pr in prompts:
+                subs.append(time.monotonic())
+                reqs.append(eng.submit(list(pr), p))
+            for r in reqs:
+                for _ in r.stream():
+                    pass
+            ttfts = sorted((r.first_token_time - t) * 1e3
+                           for r, t in zip(reqs, subs)
+                           if r.first_token_time is not None)
+            steps = max(1, eng.counters["prefill_steps_total"])
+            return {
+                "ttft_p50_ms": round(ttfts[len(ttfts) // 2], 1),
+                "ttft_p99_ms": round(ttfts[
+                    min(len(ttfts) - 1,
+                        int(len(ttfts) * 0.99))], 1),
+                "tokens_per_dispatch": round(
+                    eng.counters["prefill_tokens_total"] / steps, 1),
+                "dispatches": steps,
+            }
+        finally:
+            eng.stop()
+
+    serial = run(1)
+    packed = run(0)
+    out = {"prefill_burst_requests": n_reqs}
+    for k, v in serial.items():
+        out[f"prefill_serial_{k}"] = v
+    for k, v in packed.items():
+        out[f"prefill_pack_{k}"] = v
+    out["prefill_pack_dispatch_speedup"] = round(
+        packed["tokens_per_dispatch"] / serial["tokens_per_dispatch"], 2) \
+        if serial["tokens_per_dispatch"] else 0.0
+    out["prefill_pack_ttft_p50_speedup"] = round(
+        serial["ttft_p50_ms"] / packed["ttft_p50_ms"], 2) \
+        if packed["ttft_p50_ms"] else 0.0
+    log(f"[prefill_burst] serial {serial['tokens_per_dispatch']} tok/"
+        f"dispatch -> packed {packed['tokens_per_dispatch']} "
+        f"({out['prefill_pack_dispatch_speedup']}x); ttft p50 "
+        f"{serial['ttft_p50_ms']} -> {packed['ttft_p50_ms']} ms")
+    print(json.dumps(out), flush=True)
+
+
 def phase_int8_8b(args):
     """int8 8B-class on-chip serving: the reference's --quantization
     surface at the 8B scale a 16 GiB chip actually needs it for."""
@@ -1649,7 +1741,8 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--phase", default="",
                     choices=["", "watch", "probe", "raw", "serve",
-                             "int8_8b", "pd", "cp", "prefix", "kvpool",
+                             "int8_8b", "pd", "cp", "prefix",
+                             "prefill_burst", "kvpool",
                              "lora", "structured", "wquant_quality"])
     ap.add_argument("--cp-tokens", type=int, default=8192)
     ap.add_argument("--cp-attn-only", action="store_true",
@@ -1669,6 +1762,9 @@ def main():
                          "(0 = off; the spec on/off ladder row)")
     ap.add_argument("--skip-spec-bench", action="store_true")
     ap.add_argument("--skip-prefix-bench", action="store_true")
+    ap.add_argument("--skip-prefill-bench", action="store_true",
+                    help="skip the packed-prefill burst leg "
+                         "(docs/prefill.md)")
     ap.add_argument("--model", default="")
     ap.add_argument("--batch", type=int, default=0)
     ap.add_argument("--prompt-len", type=int, default=128)
@@ -1704,6 +1800,8 @@ def main():
         phase_probe()
     elif args.phase == "prefix":
         phase_prefix(args)
+    elif args.phase == "prefill_burst":
+        phase_prefill_burst(args)
     elif args.phase == "wquant_quality":
         phase_wquant_quality(args)
     elif args.phase == "raw":

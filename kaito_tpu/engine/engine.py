@@ -39,7 +39,7 @@ import numpy as np
 
 from kaito_tpu.engine.config import EngineConfig
 from kaito_tpu.engine.grammar import GrammarCache, GrammarSlot, GrammarTable
-from kaito_tpu.engine.kv_cache import (KVCache, create_kv_cache,
+from kaito_tpu.engine.kv_cache import (KVCache, NULL_PAGE, create_kv_cache,
                                        scale_bytes_per_page)
 from kaito_tpu.engine.model import TransformerLM
 from kaito_tpu.engine.sampler import (SamplingState, chosen_logprob,
@@ -210,6 +210,8 @@ class _Slot:
     prefill_tokens: list[int] = field(default_factory=list)
     prefill_t0: float = 0.0    # first-chunk dispatch time (cost model)
     prefill_base: int = 0      # prefill_pos at first dispatch (cached skip)
+    staged_t0: float = 0.0     # admission time: queue-wait-since-staging
+                               # vs compute in TTFT attribution
     seq: int = 0               # admission order (newest preempts first)
 
     @property
@@ -613,6 +615,20 @@ class InferenceEngine:
             "Submit-to-admission queue wait", None,
             buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
                      0.5, 1.0, 2.5, 5.0, 10.0, 30.0))
+        # packed prefill (docs/prefill.md): sequences per prefill
+        # dispatch and staged-to-first-dispatch wait — the two numbers
+        # that say whether concurrent arrivals are actually sharing
+        # bucket work or still serializing
+        self.prefill_pack_hist = Histogram(
+            "kaito:engine_prefill_pack_size",
+            "Sequences packed per prefill dispatch", None,
+            buckets=(1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 24.0, 32.0))
+        self.prefill_wait_hist = Histogram(
+            "kaito:prefill_queue_wait_seconds",
+            "Staged-to-first-prefill-dispatch wait", None,
+            buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                     0.5, 1.0, 2.5, 5.0))
+        self._prefill_pack_note = 0
 
         self._decode_fn = self._build_decode_fn()
         self._prefill_fns: dict[int, object] = {}
@@ -1226,6 +1242,30 @@ class InferenceEngine:
                 return cache, logits
 
             fn = prefill_cp
+            self._prefill_fns[key] = fn
+        return fn
+
+    def _prefill_packed_fn(self):
+        """Segment-packed prefill dispatch (docs/prefill.md): S fresh
+        prompts concatenated into one padded row.  One jitted callable
+        covers every (bucket, pack-size) combination — jax.jit retraces
+        per shape like the batch axis does."""
+        key = "pack"
+        fn = self._prefill_fns.get(key)
+        if fn is None:
+            model = self.model
+
+            @partial(jax.jit, donate_argnums=(1,))
+            def prefill_packed(params, cache, tokens, seg_ids, positions,
+                               tok_pages, last_idx, pack_pages, tok_pgslot,
+                               adapter_ids):
+                cache, logits, _ = model.prefill_packed(
+                    params, cache, tokens, seg_ids, positions, tok_pages,
+                    last_idx, pack_pages=pack_pages, tok_pgslot=tok_pgslot,
+                    adapter_ids=adapter_ids)
+                return cache, logits
+
+            fn = prefill_packed
             self._prefill_fns[key] = fn
         return fn
 
@@ -1865,6 +1905,7 @@ class InferenceEngine:
         slot.prefill_pos = 0
         slot.prefill_t0 = 0.0
         slot.prefill_base = 0
+        slot.staged_t0 = 0.0
         slot.position = 0
         slot.remaining = 0
         self.slot_adapters[slot_idx] = 0
@@ -2076,6 +2117,11 @@ class InferenceEngine:
                 # step's dispatch; ~0 whenever the pipeline was primed
                 extra["dispatch_gap"] = round(self._gap_last, 6)
                 self._gap_last = 0.0
+            if self._prefill_pack_note:
+                # largest prefill pack dispatched this step — the
+                # /debug/timeline annotation for packed rounds
+                extra["prefill_pack"] = self._prefill_pack_note
+                self._prefill_pack_note = 0
             self.timeline.add(
                 t0, wall, **extra,
                 running=self.num_running,
@@ -2282,6 +2328,7 @@ class InferenceEngine:
         slot.prefill_pos = cached
         slot.prefill_tokens = tokens
         now = time.monotonic()
+        slot.staged_t0 = now
         # queue wait only on FIRST admission — a resume after preemption
         # would re-count the whole lifetime as queue time
         if req.first_token_time is None and not req.preemptions:
@@ -2550,6 +2597,26 @@ class InferenceEngine:
                     nbytes=meta_nbytes(exp.meta)))
 
     def _advance_prefills(self) -> bool:
+        """Advance staged prefills by one scheduler round.
+
+        ``prefill_pack > 1`` (the default resolves to ``max_num_seqs``)
+        spreads the per-step token budget over a PACK of staged slots
+        (docs/prefill.md); ``prefill_pack == 1`` reproduces the serial
+        round-robin single-slot scheduler byte-identically.  Pipeline
+        parallelism keeps the serial path — its prefill runs through the
+        stage executor, which has no packed route."""
+        pack = int(getattr(self.cfg, "prefill_pack", 0))
+        if pack <= 0:
+            pack = int(os.environ.get("KAITO_PREFILL_PACK", "0") or "0")
+        if pack <= 0:
+            pack = self.cfg.max_num_seqs
+        if self.pp_exec is not None:
+            pack = 1
+        if pack <= 1:
+            return self._advance_prefill_single()
+        return self._advance_prefill_pack(pack)
+
+    def _advance_prefill_single(self) -> bool:
         """Run ONE bounded prefill chunk for one staged slot
         (round-robin), completing admission when the prompt is done."""
         idxs = [i for i, s in enumerate(self.slots)
@@ -2618,12 +2685,18 @@ class InferenceEngine:
             return True
         self.counters["prefill_steps_total"] += 1
         self.counters["prefill_tokens_total"] += m
-        self.tracer.record("prefill.chunk", req.trace_id, t_first_chunk,
-                           time.monotonic() - t_first_chunk, pos=pos,
-                           tokens=m, bucket=bucket, slot=i, cp=bool(use_cp))
+        self.prefill_pack_hist.observe(1.0)
+        wait = 0.0
         if not slot.prefill_t0:
             slot.prefill_t0 = t_first_chunk
             slot.prefill_base = pos
+            if slot.staged_t0:
+                wait = max(0.0, t_first_chunk - slot.staged_t0)
+            self.prefill_wait_hist.observe(wait)
+        self.tracer.record("prefill.chunk", req.trace_id, t_first_chunk,
+                           time.monotonic() - t_first_chunk, pos=pos,
+                           tokens=m, bucket=bucket, slot=i, cp=bool(use_cp),
+                           queue_wait=round(wait, 6))
         slot.prefill_pos = pos + m
         if slot.prefill_pos >= n:
             if not req.prompt_counted:
@@ -2642,6 +2715,346 @@ class InferenceEngine:
                     time.monotonic() - slot.prefill_t0)
             self._begin_decode(i, first, n, first_lp=first_lp)
         return True
+
+    def _advance_prefill_pack(self, pack_limit: int) -> bool:
+        """Token-budget prefill scheduling (docs/prefill.md).
+
+        Picks a PACK of staged slots — strict QoS priority, then
+        admission order — whose chunks fill ``max_prefill_tokens`` as an
+        AGGREGATE budget, and runs them in as few dispatches as
+        possible: fresh-complete prompts are segment-packed into one
+        row per adapter (one bucket's MXU work covers the whole group),
+        context chunks batch on the batch axis per bucket, and CP-long
+        prompts keep their dedicated single-shot ring dispatch.  The
+        budget bounds decode ITL exactly as the serial path did; a
+        single-slot group dispatches through the same jitted family as
+        the serial scheduler, so light traffic is numerically untouched.
+        """
+        staged = [i for i, s in enumerate(self.slots)
+                  if s.request is not None and s.prefilling
+                  and not s.importing]
+        if not staged:
+            return False
+        staged.sort(key=lambda i: (-self.slots[i].request.priority,
+                                   self.slots[i].seq))
+        budget = max(self.cfg.max_prefill_tokens, self.cfg.page_size)
+        left = budget
+        picks: list[tuple[int, int, int, int]] = []  # (slot, pos, take, n)
+        cp_pick = None
+        for i in staged:
+            if len(picks) >= pack_limit or left <= 0:
+                break
+            slot = self.slots[i]
+            n = len(slot.prefill_tokens)
+            pos = slot.prefill_pos
+            use_cp = (self.model.cp is not None and pos == 0
+                      and n >= self.cfg.cp_min_tokens
+                      and self._bucket(n) % dict(
+                          self.model.cp[0].shape)["sequence"] == 0)
+            if use_cp:
+                # the ring shards the memory the budget was bounding; it
+                # runs ALONE — first in priority order, or next round
+                if not picks:
+                    cp_pick = i
+                break
+            take = min(n - pos, left)
+            if take <= 0:
+                break
+            if take < n - pos and picks and take < self.cfg.page_size:
+                # sub-page tail of the budget: leave it whole for the
+                # next round instead of fragmenting a long prompt
+                break
+            picks.append((i, pos, take, n))
+            left -= take
+        if cp_pick is not None:
+            return self._dispatch_prefill_cp(cp_pick)
+        if not picks:
+            return False
+
+        # group into dispatches, preserving priority order of first
+        # members: fresh-complete prompts segment-pack per adapter
+        # (batch-axis per bucket for MLA, which has no packed kernel),
+        # context chunks batch per bucket
+        mla = self.model.is_mla
+        groups: list[tuple[tuple, list]] = []
+        index: dict[tuple, int] = {}
+        for p in picks:
+            i, pos, take, n = p
+            if pos == 0 and take == n:
+                gk = (("fresh", self._bucket(take)) if mla
+                      else ("seg", int(self.slot_adapters[i])))
+            else:
+                gk = ("ctx", self._bucket(take))
+            if gk in index:
+                groups[index[gk]][1].append(p)
+            else:
+                index[gk] = len(groups)
+                groups.append((gk, [p]))
+
+        did = False
+        completed = []   # (slot_idx, n, logits, row)
+        for gk, rows in groups:
+            t0 = time.monotonic()
+            try:
+                for (i, _, _, _) in rows:
+                    FAILPOINTS.fire("engine.prefill",
+                                    req_id=self.slots[i].request.req_id)
+                if gk[0] == "seg" and len(rows) > 1:
+                    logits = self._dispatch_prefill_packed(rows)
+                elif gk[0] == "ctx":
+                    logits = self._dispatch_prefill_ctx(rows)
+                else:
+                    # single fresh prompt or MLA fresh bucket: the
+                    # serial scheduler's own jitted family, batched
+                    logits = self._dispatch_prefill_fresh(rows)
+            except Exception as e:
+                logger.exception("prefill dispatch failed (%d slots)",
+                                 len(rows))
+                for (i, _, _, _) in rows:
+                    req = self.slots[i].request
+                    self._evict_slot(i, commit=False)
+                    self._fail_request(req, etype="prefill_failed",
+                                       message=f"prefill failed: "
+                                               f"{type(e).__name__}: {e}")
+                self._recover_cache_if_poisoned()
+                return True
+            dur = time.monotonic() - t0
+            self.counters["prefill_steps_total"] += 1
+            self.counters["prefill_tokens_total"] += sum(
+                take for (_, _, take, _) in rows)
+            self.prefill_pack_hist.observe(float(len(rows)))
+            self._prefill_pack_note = max(self._prefill_pack_note,
+                                          len(rows))
+            for row, (i, pos, take, n) in enumerate(rows):
+                slot = self.slots[i]
+                req = slot.request
+                wait = 0.0
+                if not slot.prefill_t0:
+                    slot.prefill_t0 = t0
+                    slot.prefill_base = pos
+                    if slot.staged_t0:
+                        wait = max(0.0, t0 - slot.staged_t0)
+                    self.prefill_wait_hist.observe(wait)
+                self.tracer.record(
+                    "prefill.chunk", req.trace_id, t0, dur, pos=pos,
+                    tokens=take, bucket=self._bucket(take), slot=i,
+                    cp=False, pack=len(rows), queue_wait=round(wait, 6))
+                slot.prefill_pos = pos + take
+                if slot.prefill_pos >= n:
+                    completed.append((i, n, logits, row))
+            did = True
+
+        if completed:
+            if len(completed) == 1:
+                i, n, logits, row = completed[0]
+                rows_l = logits[row:row + 1]
+            else:
+                rows_l = jnp.concatenate(
+                    [lg[r:r + 1] for (_, _, lg, r) in completed], axis=0)
+            idxs = [i for (i, _, _, _) in completed]
+            toks, lps = self._sample_first_batch(idxs, rows_l)
+            t_done = time.monotonic()
+            for (i, n, _, _), tok, lp in zip(completed, toks, lps):
+                slot = self.slots[i]
+                req = slot.request
+                if not req.prompt_counted:
+                    self.counters["prompt_tokens_total"] += \
+                        len(req.prompt_tokens)
+                    req.prompt_counted = True
+                slot.prefilling = False
+                if slot.prefill_t0:
+                    self.pd_costs.note_prefill(n - slot.prefill_base,
+                                               t_done - slot.prefill_t0)
+                self._begin_decode(i, tok, n, first_lp=lp)
+        return did
+
+    def _dispatch_prefill_cp(self, i: int) -> bool:
+        """Single-slot context-parallel dispatch from the pack path —
+        the same route `_advance_prefill_single` takes for CP prompts."""
+        slot = self.slots[i]
+        req = slot.request
+        n = len(slot.prefill_tokens)
+        bucket = self._bucket(n)
+        ctoks = np.zeros((1, bucket), np.int32)
+        ctoks[0, :n] = slot.prefill_tokens
+        aid = jnp.asarray(self.slot_adapters[i:i + 1])
+        t0 = time.monotonic()
+        try:
+            FAILPOINTS.fire("engine.prefill", req_id=req.req_id)
+            fn = self._prefill_cp_fn(bucket)
+            self.cache, logits = fn(self.params, self.cache,
+                                    jnp.asarray(ctoks),
+                                    jnp.asarray([n], np.int32),
+                                    jnp.asarray(self.page_tables[i][None]),
+                                    aid)
+        except Exception as e:
+            logger.exception("prefill failed for %s", req.req_id)
+            self._evict_slot(i, commit=False)
+            self._fail_request(req, etype="prefill_failed",
+                               message=f"prefill failed: "
+                                       f"{type(e).__name__}: {e}")
+            self._recover_cache_if_poisoned()
+            return True
+        self.counters["prefill_steps_total"] += 1
+        self.counters["prefill_tokens_total"] += n
+        self.prefill_pack_hist.observe(1.0)
+        wait = 0.0
+        if not slot.prefill_t0:
+            slot.prefill_t0 = t0
+            slot.prefill_base = 0
+            if slot.staged_t0:
+                wait = max(0.0, t0 - slot.staged_t0)
+            self.prefill_wait_hist.observe(wait)
+        self.tracer.record("prefill.chunk", req.trace_id, t0,
+                           time.monotonic() - t0, pos=0, tokens=n,
+                           bucket=bucket, slot=i, cp=True, pack=1,
+                           queue_wait=round(wait, 6))
+        slot.prefill_pos = n
+        if not req.prompt_counted:
+            self.counters["prompt_tokens_total"] += len(req.prompt_tokens)
+            req.prompt_counted = True
+        slot.prefilling = False
+        first, first_lp = self._sample_first(i, logits)
+        if slot.prefill_t0:
+            self.pd_costs.note_prefill(n - slot.prefill_base,
+                                       time.monotonic() - slot.prefill_t0)
+        self._begin_decode(i, first, n, first_lp=first_lp)
+        return True
+
+    def _dispatch_prefill_fresh(self, rows):
+        """Batch-axis dispatch of fresh-complete prompts sharing one
+        bucket: tokens [B, bucket] with per-row true_lens/page tables —
+        `model.prefill` was already row-wise, the serial scheduler just
+        never passed B > 1."""
+        bucket = self._bucket(max(n for (_, _, _, n) in rows))
+        B = len(rows)
+        ctoks = np.zeros((B, bucket), np.int32)
+        tls = np.zeros((B,), np.int32)
+        pts = np.zeros((B,) + self.page_tables[0].shape, np.int32)
+        aids = np.zeros((B,), np.int32)
+        for j, (i, _, _, n) in enumerate(rows):
+            ctoks[j, :n] = self.slots[i].prefill_tokens
+            tls[j] = n
+            pts[j] = self.page_tables[i]
+            aids[j] = self.slot_adapters[i]
+        fn = self._prefill_fn(bucket)
+        self.cache, logits = fn(self.params, self.cache,
+                                jnp.asarray(ctoks), jnp.asarray(tls),
+                                jnp.asarray(pts), jnp.asarray(aids))
+        return logits
+
+    def _dispatch_prefill_ctx(self, rows):
+        """Batch-axis dispatch of context chunks sharing one bucket:
+        per-row start_pos, each chunk attending over its own paged
+        history (cached prefix + earlier chunks)."""
+        bucket = self._bucket(max(take for (_, _, take, _) in rows))
+        B = len(rows)
+        ctoks = np.zeros((B, bucket), np.int32)
+        tls = np.zeros((B,), np.int32)
+        sps = np.zeros((B,), np.int32)
+        pts = np.zeros((B,) + self.page_tables[0].shape, np.int32)
+        aids = np.zeros((B,), np.int32)
+        for j, (i, pos, take, _) in enumerate(rows):
+            ctoks[j, :take] = self.slots[i].prefill_tokens[pos:pos + take]
+            tls[j] = take
+            sps[j] = pos
+            pts[j] = self.page_tables[i]
+            aids[j] = self.slot_adapters[i]
+        fn = self._prefill_ctx_fn(bucket)
+        self.cache, logits = fn(self.params, self.cache,
+                                jnp.asarray(ctoks), jnp.asarray(tls),
+                                jnp.asarray(pts), jnp.asarray(sps),
+                                jnp.asarray(aids))
+        return logits
+
+    def _dispatch_prefill_packed(self, rows):
+        """Sequence-axis segment packing: concatenate S fresh prompts
+        (same adapter) into ONE padded row with per-token segment ids,
+        positions and page targets, so short prompts share one bucket's
+        MXU work instead of each padding a batch-1 row (docs/prefill.md).
+        Returns last-token logits [S, V] in pack order."""
+        ps = self.cfg.page_size
+        total = sum(take for (_, _, take, _) in rows)
+        T = self._bucket(total)
+        S = len(rows)
+        int8 = self.cache.k_scale is not None
+        toks = np.zeros((1, T), np.int32)
+        segs = np.full((1, T), -1, np.int32)
+        poss = np.zeros((1, T), np.int32)
+        tok_pages = np.full((T,), NULL_PAGE, np.int32)
+        last_idx = np.zeros((S,), np.int32)
+        pack_pages = tok_pgslot = None
+        if int8:
+            # pad the page span to a budget-derived constant so the jit
+            # trace is keyed only by (bucket, pack size)
+            budget = max(self.cfg.max_prefill_tokens, self.cfg.page_size)
+            npg_max = budget // ps + S + 1
+            pack_pages = np.full((npg_max,), NULL_PAGE, np.int32)
+            tok_pgslot = np.full((T,), npg_max, np.int32)  # OOB -> dropped
+        off = 0
+        pg = 0
+        for si, (i, _, take, _) in enumerate(rows):
+            toks[0, off:off + take] = self.slots[i].prefill_tokens
+            segs[0, off:off + take] = si
+            rel = np.arange(take, dtype=np.int32)
+            poss[0, off:off + take] = rel
+            table = self.page_tables[i]
+            tok_pages[off:off + take] = table[rel // ps]
+            if int8:
+                npg = (take + ps - 1) // ps
+                pack_pages[pg:pg + npg] = table[:npg]
+                tok_pgslot[off:off + take] = pg + rel // ps
+                pg += npg
+            last_idx[si] = off + take - 1
+            off += take
+        fn = self._prefill_packed_fn()
+        aid = jnp.asarray(self.slot_adapters[rows[0][0]:rows[0][0] + 1])
+        self.cache, logits = fn(
+            self.params, self.cache, jnp.asarray(toks), jnp.asarray(segs),
+            jnp.asarray(poss), jnp.asarray(tok_pages),
+            jnp.asarray(last_idx),
+            jnp.asarray(pack_pages) if int8 else None,
+            jnp.asarray(tok_pgslot) if int8 else None, aid)
+        return logits
+
+    def _sample_first_batch(self, idxs: list[int], logits
+                            ) -> tuple[list[int], list[float]]:
+        """Fused first-token sampling for every sequence completing in a
+        prefill round: ONE sampler dispatch over the gathered rows,
+        per-slot grammar rows honored (zero rows for unconstrained
+        slots are an exact no-op on the logits)."""
+        s = self.sampling
+        sel = jnp.asarray(np.asarray(idxs, np.int32))
+        sub = SamplingState(
+            temperature=s.temperature[sel], top_k=s.top_k[sel],
+            top_p=s.top_p[sel], key=s.key[sel], presence=s.presence[sel],
+            frequency=s.frequency[sel], repetition=s.repetition[sel],
+            min_p=s.min_p[sel])
+        gr = None
+        if any(self._gram_slots[i] is not None for i in idxs):
+            V = self.md.arch.vocab_size
+            rows = np.zeros((len(idxs), V), np.float32)
+            for j, i in enumerate(idxs):
+                gs = self._gram_slots[i]
+                if gs is not None:
+                    rows[j] = self._gram_row(gs)
+            gr = jnp.asarray(rows)
+        if self.token_counts is not None:
+            tok, sub = self._sample_one(
+                logits, sub, self.token_counts[sel],
+                self.prompt_seen[sel], gr)
+        elif gr is not None:
+            tok, sub = self._sample_one(logits, sub, None, None, gr)
+        else:
+            tok, sub = self._sample_one(logits, sub)
+        lps = chosen_logprob(jnp.asarray(logits), tok)
+        self.sampling = SamplingState(
+            temperature=s.temperature, top_k=s.top_k, top_p=s.top_p,
+            key=s.key.at[sel].set(sub.key),
+            presence=s.presence, frequency=s.frequency,
+            repetition=s.repetition, min_p=s.min_p)
+        return ([int(t) for t in np.asarray(tok)],
+                [float(x) for x in np.asarray(lps)])
 
     def _sample_first(self, slot_idx: int, logits) -> tuple[int, float]:
         s = self.sampling

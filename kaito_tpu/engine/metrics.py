@@ -361,7 +361,8 @@ class EngineMetrics:
             # from the scheduler thread); expose them through this
             # registry rather than duplicating series
             for attr in ("step_hist", "queue_wait_hist",
-                         "dispatch_gap_hist"):
+                         "dispatch_gap_hist", "prefill_pack_hist",
+                         "prefill_wait_hist"):
                 h = getattr(engine, attr, None)
                 if h is not None:
                     r.register(h)

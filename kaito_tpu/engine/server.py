@@ -1873,6 +1873,13 @@ def main(argv=None):
                          "(0 disables; reference contract "
                          "inference_api.py:503-556)")
     ap.add_argument("--max-queue-len", type=int, default=256)
+    ap.add_argument("--prefill-pack", type=int,
+                    default=int(os.environ.get("KAITO_PREFILL_PACK", "0")),
+                    help="max staged sequences packed into one prefill "
+                         "round under the shared token budget "
+                         "(docs/prefill.md); 0 = auto (up to "
+                         "max-num-seqs), 1 = serial round-robin "
+                         "(byte-identical legacy scheduler)")
     ap.add_argument("--qos-config",
                     default=os.environ.get("KAITO_QOS_CONFIG", ""),
                     help="multi-tenant QoS classes as inline JSON or "
@@ -1976,6 +1983,7 @@ def main(argv=None):
             args.kaito_kv_cache_cpu_memory_utilization
             * os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES")),
         max_queue_len=args.max_queue_len,
+        prefill_pack=args.prefill_pack,
         qos_config=args.qos_config,
         max_pages=args.max_pages,
         speculative_ngram=args.speculative_ngram,

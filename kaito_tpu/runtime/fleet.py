@@ -103,6 +103,15 @@ _ENGINE_COUNTERS = {
     "kaito:adapter_hits_total": "adapter_hits_total",
     "kaito:grammar_cache_hits_total": "grammar_hits_total",
     "kaito:grammar_cache_misses_total": "grammar_misses_total",
+    # packed prefill (docs/prefill.md): histogram _sum/_count fold into
+    # plain counters (a fleet-level histogram merge would need every
+    # bucket edge; mean pack size + dispatch rate answer the capacity
+    # question), plus the prompt-token counter for tokens/s
+    "kaito:prompt_tokens_total": "prompt_tokens_total",
+    "kaito:engine_prefill_pack_size_sum": "prefill_packed_seqs_total",
+    "kaito:engine_prefill_pack_size_count": "prefill_dispatches_total",
+    "kaito:prefill_queue_wait_seconds_sum": "prefill_wait_seconds_total",
+    "kaito:prefill_queue_wait_seconds_count": "prefill_waits_total",
 }
 # EPP / router front series (arrival side of the same CR).  The
 # received counter keeps ticking even with ZERO backends — it is the
@@ -635,6 +644,9 @@ class FleetTelemetry:
                 "adapter_loads_total", "adapter_evictions_total",
                 "adapter_hits_total",
                 "grammar_hits_total", "grammar_misses_total",
+                "prompt_tokens_total", "prefill_packed_seqs_total",
+                "prefill_dispatches_total", "prefill_wait_seconds_total",
+                "prefill_waits_total",
                 "forwarded_total", "received_total"]
         # per-tenant counters carry the tenant in the key itself
         # ("tenant_shed_total:acme"), so rate whatever both samples have
@@ -810,6 +822,20 @@ class FleetTelemetry:
             "grammar_cache_hit_rate": (
                 gr_hit / (gr_hit + gr_miss)
                 if gr_hit + gr_miss > 0 else 0.0),
+            # packed prefill (docs/prefill.md): prompt tokens/s,
+            # prefill dispatches/s, mean sequences per dispatch (the
+            # packing win — 1.0 means serial), and mean staged->first-
+            # dispatch queue wait (the TTFT component packing attacks)
+            "prefill_tokens_rate": rate("prompt_tokens_rate"),
+            "prefill_dispatch_rate": rate("prefill_dispatches_rate"),
+            "prefill_pack_mean": (
+                rate("prefill_packed_seqs_rate")
+                / rate("prefill_dispatches_rate")
+                if rate("prefill_dispatches_rate") > 0 else 0.0),
+            "prefill_queue_wait_mean": (
+                rate("prefill_wait_seconds_rate")
+                / rate("prefill_waits_rate")
+                if rate("prefill_waits_rate") > 0 else 0.0),
         }
         if epps:
             agg["arrival_rate"] = sum(
@@ -1042,6 +1068,20 @@ class FleetTelemetry:
               "Fleet grammar compile-cache hit ratio for constrained "
               "requests (rate-weighted)", r,
               labels=("kind", "name"), fn=family("grammar_cache_hit_rate"))
+        Gauge("kaito:fleet_prefill_tokens_per_s",
+              "Fleet prompt-token prefill rate", r,
+              labels=("kind", "name"), fn=family("prefill_tokens_rate"))
+        Gauge("kaito:fleet_prefill_dispatches_per_s",
+              "Fleet prefill dispatch rate (packed rounds count once)", r,
+              labels=("kind", "name"), fn=family("prefill_dispatch_rate"))
+        Gauge("kaito:fleet_prefill_pack_mean",
+              "Mean sequences per prefill dispatch across the fleet "
+              "(1.0 = serial; higher = packing engaged)", r,
+              labels=("kind", "name"), fn=family("prefill_pack_mean"))
+        Gauge("kaito:fleet_prefill_queue_wait_mean",
+              "Mean staged-to-first-prefill-dispatch wait across the "
+              "fleet (seconds)", r,
+              labels=("kind", "name"), fn=family("prefill_queue_wait_mean"))
 
         def tenant_family(prefix):
             def _fn():

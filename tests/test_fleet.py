@@ -128,6 +128,58 @@ kaito:requests_served_total{tenant="acme"} 12
 """
 
 
+PREFILL_PAYLOAD = ENGINE_PAYLOAD + """\
+# TYPE kaito:prompt_tokens_total counter
+kaito:prompt_tokens_total 4096
+# TYPE kaito:engine_prefill_pack_size histogram
+kaito:engine_prefill_pack_size_bucket{le="1"} 2
+kaito:engine_prefill_pack_size_bucket{le="+Inf"} 10
+kaito:engine_prefill_pack_size_sum 30
+kaito:engine_prefill_pack_size_count 10
+# TYPE kaito:prefill_queue_wait_seconds histogram
+kaito:prefill_queue_wait_seconds_bucket{le="+Inf"} 8
+kaito:prefill_queue_wait_seconds_sum 0.4
+kaito:prefill_queue_wait_seconds_count 8
+"""
+
+
+def test_prefill_pack_series_parse_rate_and_aggregate():
+    """Packed-prefill telemetry (docs/prefill.md): the histogram's
+    _sum/_count fold as counters, rate like any other, and aggregate
+    into the fleet pack-mean / queue-wait-mean gauge fields."""
+    vals = parse_replica_metrics(PREFILL_PAYLOAD)
+    assert vals["prompt_tokens_total"] == 4096.0
+    assert vals["prefill_packed_seqs_total"] == 30.0
+    assert vals["prefill_dispatches_total"] == 10.0
+    assert vals["prefill_wait_seconds_total"] == pytest.approx(0.4)
+    assert vals["prefill_waits_total"] == 8.0
+    # bucket lines never alias into the fold
+    assert all("bucket" not in k for k in vals)
+
+    clock = Clock()
+    ft = FleetTelemetry(Store(), time_fn=clock)
+    prev = ReplicaSample(ts=clock() - 10.0,
+                         values={"prefill_packed_seqs_total": 0.0,
+                                 "prefill_dispatches_total": 0.0,
+                                 "prefill_wait_seconds_total": 0.0,
+                                 "prefill_waits_total": 0.0,
+                                 "prompt_tokens_total": 0.0,
+                                 "uptime_s": 50.0})
+    rates = ft._rates(prev, vals, clock())
+    assert rates["prompt_tokens_rate"] == pytest.approx(409.6)
+    assert rates["prefill_packed_seqs_rate"] == pytest.approx(3.0)
+    assert rates["prefill_dispatches_rate"] == pytest.approx(1.0)
+
+    key = ("InferenceSet", "default", "pack")
+    ft.ingest(key, "http://r0:5000", vals, rates=rates)
+    ft.fold()
+    agg = ft._last_agg[key]
+    assert agg["prefill_tokens_rate"] == pytest.approx(409.6)
+    assert agg["prefill_dispatch_rate"] == pytest.approx(1.0)
+    assert agg["prefill_pack_mean"] == pytest.approx(3.0)
+    assert agg["prefill_queue_wait_mean"] == pytest.approx(0.05)
+
+
 def test_per_tenant_counters_parse_rate_and_aggregate():
     vals = parse_replica_metrics(TENANT_PAYLOAD)
     assert vals["tenant_shed_total:free"] == 8.0
