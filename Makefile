@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: all native unit-test unit-test-fast unit-test-slow engine-test rag-test chaos kvq wquant kvpool lora structured obs slo fleet autoscale spec qos asyncloop prefill bench serve manager epp clean
+.PHONY: all native unit-test unit-test-fast unit-test-slow engine-test rag-test chaos kvq wquant kvpool lora structured obs devprof slo fleet autoscale spec qos asyncloop prefill bench serve manager epp clean
 
 all: native
 
@@ -82,7 +82,17 @@ structured:
 obs:
 	$(PYTHON) -m pytest tests/test_tracing.py tests/test_metrics_format.py \
 	  tests/test_slo.py tests/test_controllers.py tests/test_fleet.py \
-	  tests/test_prefill_pack.py -q -m "not slow"
+	  tests/test_prefill_pack.py tests/test_devprof.py -q -m "not slow"
+
+# device-time attribution suite (docs/observability.md "Device-time
+# attribution"): bucket classifier, XPlane wire + chrome-trace parsers,
+# buckets+idle==100 invariant, cross-track overlap %, phase markers,
+# gated-off exposition pin, fleet fold, annotation render/plan
+# validation, AND the live CPU-smoke leg: a sampled window against a
+# real engine process (buckets sum to 100, >90% phase attribution,
+# /debug/device vs /metrics agreement, 403 when off)
+devprof:
+	$(PYTHON) -m pytest tests/test_devprof.py -q
 
 # SLO watchdog suite alone (docs/observability.md "Control plane")
 slo:

@@ -127,12 +127,21 @@ def plan_workspace(store: Store, ws: Workspace):
     # code the renderer runs, so plan-time acceptance == render-time
     # acceptance (docs/multi-lora.md)
     from kaito_tpu.manifests.inference import (
-        parse_adapters_annotation, parse_structured_output_annotation)
+        parse_adapters_annotation, parse_devprof_annotation,
+        parse_structured_output_annotation)
     try:
         parse_adapters_annotation(ws.metadata.annotations.get(
             "kaito-tpu.io/adapters", ""))
     except ValueError as e:
         raise ValueError(f"invalid kaito-tpu.io/adapters annotation: {e}")
+    # a malformed devprof interval fails the plan the same way — the
+    # exact parse the renderer runs, so plan-time acceptance ==
+    # render-time acceptance (docs/observability.md)
+    try:
+        parse_devprof_annotation(ws.metadata.annotations.get(
+            "kaito-tpu.io/devprof", ""))
+    except ValueError as e:
+        raise ValueError(f"invalid kaito-tpu.io/devprof annotation: {e}")
     # a malformed structured-output document fails the plan the same
     # way — again the exact parse the renderer runs, so plan-time
     # acceptance == render-time acceptance (docs/structured-output.md)

@@ -176,6 +176,14 @@ class EngineConfig:
     slo_ttft_p99_ms: float = 1000.0
     slo_tokens_per_sec_per_chip: float = 2000.0
     slo_availability: float = 0.999
+    # sampled device-time attribution (engine/devprof.py).  0 = off —
+    # no sampler thread, no kaito:device_* families, /debug/device 403,
+    # byte-identical exposition.  >0 captures a devprof_window_s
+    # jax.profiler window every devprof_interval_s and folds it into
+    # comm/compute/idle buckets + per-phase device metrics.
+    devprof_interval_s: float = 0.0
+    devprof_window_s: float = 0.25       # capture length per sample
+    devprof_ring: int = 16               # recent windows kept for /debug/device
 
     def replace(self, **kw) -> "EngineConfig":
         return dataclasses.replace(self, **kw)

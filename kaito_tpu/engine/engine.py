@@ -38,6 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from kaito_tpu.engine.config import EngineConfig
+from kaito_tpu.engine.devprof import phase_scope
 from kaito_tpu.engine.grammar import GrammarCache, GrammarSlot, GrammarTable
 from kaito_tpu.engine.kv_cache import (KVCache, NULL_PAGE, create_kv_cache,
                                        scale_bytes_per_page)
@@ -707,9 +708,45 @@ class InferenceEngine:
         # break-even decision (static knobs are cold-start priors only)
         self.pd_costs = TransferCostModel()
 
+        # sampled device-time attribution (docs/observability.md).  Off
+        # by default: no sampler thread, no kaito:device_* families,
+        # /debug/device 403 — the exposition stays byte-identical.
+        self.devprof = None
+        if getattr(cfg, "devprof_interval_s", 0.0) > 0:
+            from kaito_tpu.engine.devprof import DeviceProfiler
+
+            self.devprof = DeviceProfiler(
+                interval_s=cfg.devprof_interval_s,
+                window_s=getattr(cfg, "devprof_window_s", 0.25),
+                ring=getattr(cfg, "devprof_ring", 16),
+                roofline=self._devprof_roofline(),
+                tokens_fn=lambda: self.counters["generation_tokens_total"])
+            logger.info("device profiler enabled: %.3gs window every "
+                        "%.3gs", self.devprof.window_s,
+                        self.devprof.interval_s)
+
     # ------------------------------------------------------------------
     # Construction helpers
     # ------------------------------------------------------------------
+
+    def _devprof_roofline(self) -> dict:
+        """Chip peaks + model constants for devprof's achieved-vs-peak
+        window rates — the same math as bench._roofline_metrics, minus
+        the per-sequence KV term (batch composition changes mid-window,
+        so the weight stream is the stable lower bound)."""
+        from kaito_tpu.sku.catalog import CHIP_CATALOG
+
+        chip = CHIP_CATALOG.get("v5e")
+        quant = self.cfg.quantization or ""
+        n_params = self.md.arch.param_count()
+        peak_flops = (chip.int8_tops if quant == "int8"
+                      else chip.bf16_tflops) * 1e12
+        param_bytes = n_params * {"": 2.0, "int8": 1.0,
+                                  "int4": 0.53125}.get(quant, 2.0)
+        return {"params": float(n_params),
+                "bytes_per_tok": float(param_bytes),
+                "peak_flops": peak_flops,
+                "peak_bytes_s": chip.hbm_gbps * 1e9}
 
     def _build_mesh(self):
         """SP×EP×TP mesh from config (the planner's sequence/expert/
@@ -1097,6 +1134,7 @@ class InferenceEngine:
                      if self.pp_exec is not None else None)
 
         @partial(jax.jit, donate_argnums=(1, 2, 3))
+        @phase_scope("decode")
         def decode_step(params, cache, sampling, counts, prompt_seen,
                         tokens, positions, page_tables, active, adapter_ids,
                         gmask, gtrans, gstate):
@@ -1153,6 +1191,7 @@ class InferenceEngine:
         model = self.model
 
         @partial(jax.jit, donate_argnums=(1, 2, 3))
+        @phase_scope("decode")
         def decode_multi(params, cache, sampling, counts, prompt_seen,
                          tokens, positions, page_tables, active, adapter_ids,
                          stop_ids, steps_left, gmask, gtrans, gstate):
@@ -1211,6 +1250,7 @@ class InferenceEngine:
                           if self.pp_exec is not None else None)
 
             @partial(jax.jit, donate_argnums=(1,))
+            @phase_scope("prefill")
             def prefill_step(params, cache, tokens, true_lens, page_tables,
                              adapter_ids):
                 if pp_prefill is not None:
@@ -1234,6 +1274,7 @@ class InferenceEngine:
             model = self.model
 
             @partial(jax.jit, donate_argnums=(1,))
+            @phase_scope("prefill")
             def prefill_cp(params, cache, tokens, true_lens, page_tables,
                            adapter_ids):
                 cache, logits, _ = model.prefill_cp(
@@ -1256,6 +1297,7 @@ class InferenceEngine:
             model = self.model
 
             @partial(jax.jit, donate_argnums=(1,))
+            @phase_scope("prefill_packed")
             def prefill_packed(params, cache, tokens, seg_ids, positions,
                                tok_pages, last_idx, pack_pages, tok_pgslot,
                                adapter_ids):
@@ -1278,6 +1320,7 @@ class InferenceEngine:
                           if self.pp_exec is not None else None)
 
             @partial(jax.jit, donate_argnums=(1,))
+            @phase_scope("prefill")
             def prefill_ctx(params, cache, tokens, true_lens, page_tables,
                             start_pos, adapter_ids):
                 if pp_prefill is not None:
@@ -1722,8 +1765,12 @@ class InferenceEngine:
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="engine-loop")
         self._thread.start()
+        if self.devprof is not None:
+            self.devprof.start()
 
     def stop(self):
+        if self.devprof is not None:
+            self.devprof.stop()
         self._stop.set()
         self._wake.set()
         if self._thread:
@@ -2481,7 +2528,8 @@ class InferenceEngine:
                         n = len(req.prompt_tokens)
                         n_pages = -(-n // self.cfg.page_size)
                         with self.tracer.span("kv.import.chunked",
-                                              req.trace_id, pages=n_pages):
+                                              req.trace_id,
+                                              pages=n_pages):
                             self.cache = import_arrays(
                                 self.cache, slot.pages[:n_pages],
                                 *ci.full_arrays())
@@ -2568,7 +2616,8 @@ class InferenceEngine:
             kp, vp = _pad(k), _pad(v)
             if ks is not None:
                 ksp, vsp = _pad(ks), _pad(vs)
-        with self.tracer.span("kv.pool.import", req.trace_id, pages=n_use):
+        with self.tracer.span("kv.pool.import", req.trace_id,
+                              pages=n_use):
             self.cache = import_arrays(self.cache, pages, kp, vp, ksp, vsp)
         slot.importing = False
         # _admit staged the prefill fields already (exclusive acquire,
@@ -2882,11 +2931,10 @@ class InferenceEngine:
         try:
             FAILPOINTS.fire("engine.prefill", req_id=req.req_id)
             fn = self._prefill_cp_fn(bucket)
-            self.cache, logits = fn(self.params, self.cache,
-                                    jnp.asarray(ctoks),
-                                    jnp.asarray([n], np.int32),
-                                    jnp.asarray(self.page_tables[i][None]),
-                                    aid)
+            self.cache, logits = fn(
+                self.params, self.cache, jnp.asarray(ctoks),
+                jnp.asarray([n], np.int32),
+                jnp.asarray(self.page_tables[i][None]), aid)
         except Exception as e:
             logger.exception("prefill failed for %s", req.req_id)
             self._evict_slot(i, commit=False)
@@ -3010,9 +3058,9 @@ class InferenceEngine:
         fn = self._prefill_packed_fn()
         aid = jnp.asarray(self.slot_adapters[rows[0][0]:rows[0][0] + 1])
         self.cache, logits = fn(
-            self.params, self.cache, jnp.asarray(toks), jnp.asarray(segs),
-            jnp.asarray(poss), jnp.asarray(tok_pages),
-            jnp.asarray(last_idx),
+            self.params, self.cache, jnp.asarray(toks),
+            jnp.asarray(segs), jnp.asarray(poss),
+            jnp.asarray(tok_pages), jnp.asarray(last_idx),
             jnp.asarray(pack_pages) if int8 else None,
             jnp.asarray(tok_pgslot) if int8 else None, aid)
         return logits
@@ -3983,6 +4031,7 @@ class InferenceEngine:
             model = self.model
 
             @partial(jax.jit, donate_argnums=(1,))
+            @phase_scope("verify")
             def verify(params, cache, tokens, true_lens, page_tables,
                        start_pos, adapter_ids, gmask, grows):
                 if gmask.shape[0] > 1:
@@ -4018,6 +4067,7 @@ class InferenceEngine:
             model = self.model
 
             @partial(jax.jit, donate_argnums=(1,))
+            @phase_scope("verify")
             def verify_accept(params, cache, tokens, true_lens,
                               page_tables, start_pos, adapter_ids,
                               draft_logits, prop_len, temperature,
@@ -4098,9 +4148,9 @@ class InferenceEngine:
             grows[r] = self._gram_rows_for(i, p, W)
         gmask, _, _ = self._grammar_args()
         cache, targets, lps = self._verify_fn(W)(
-            self.params, self.cache, jnp.asarray(toks), jnp.asarray(tl),
-            jnp.asarray(tables), jnp.asarray(sp), jnp.asarray(aids),
-            gmask, jnp.asarray(grows))
+            self.params, self.cache, jnp.asarray(toks),
+            jnp.asarray(tl), jnp.asarray(tables), jnp.asarray(sp),
+            jnp.asarray(aids), gmask, jnp.asarray(grows))
         self.cache = cache
         # one bulk D2H + tolist per window: acceptance and replay run on
         # Python scalars, not per-token np conversions
@@ -4278,10 +4328,11 @@ class InferenceEngine:
         keys = runner.gather_keys(slot_map)
         gmask_v, _, _ = self._grammar_args()
         cache, out, n_emit, lps, new_keys = self._verify_accept_fn(W)(
-            self.params, self.cache, jnp.asarray(toks), jnp.asarray(tl),
-            jnp.asarray(tables), jnp.asarray(sp), jnp.asarray(aids),
-            dlogits, jnp.asarray(prop_len), jnp.asarray(temps),
-            jnp.asarray(onehot), keys, gmask_v, jnp.asarray(grows))
+            self.params, self.cache, jnp.asarray(toks),
+            jnp.asarray(tl), jnp.asarray(tables), jnp.asarray(sp),
+            jnp.asarray(aids), dlogits, jnp.asarray(prop_len),
+            jnp.asarray(temps), jnp.asarray(onehot), keys, gmask_v,
+            jnp.asarray(grows))
         self.cache = cache
         runner.scatter_keys(slot_map, new_keys)
         out = np.asarray(out).tolist()

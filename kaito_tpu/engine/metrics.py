@@ -488,6 +488,57 @@ class EngineMetrics:
                       "state)", r,
                       fn=lambda: engine.counters.get(
                           "h2d_uploads_total", 0))
+            if getattr(engine, "devprof", None) is not None:
+                # sampled device-time attribution (engine/devprof.py):
+                # families exist ONLY with --devprof-interval-s > 0 —
+                # same byte-identical-off discipline as the KV pool.
+                # Gauges read the LAST sampled window (0.0 before the
+                # first capture lands, so the schema is stable from
+                # scrape one).
+                dp = engine.devprof
+                r.register(dp.capture_hist)
+                Gauge("kaito:device_bucket_pct",
+                      "Share of device wall in each op class for the "
+                      "last sampled window (buckets + idle sum to 100)",
+                      r, labels=("bucket",), fn=dp.bucket_pct)
+                Gauge("kaito:device_phase_pct",
+                      "Share of device wall attributed to each "
+                      "named-scope engine phase (kaito/<phase>)", r,
+                      labels=("phase",), fn=dp.phase_pct)
+                Gauge("kaito:device_comm_pct",
+                      "Collective share of device wall, last window", r,
+                      fn=dp.comm_pct)
+                Gauge("kaito:device_comm_compute_overlap_pct",
+                      "Share of collective time co-scheduled with "
+                      "compute on another unit (hidden, not serialized)",
+                      r, fn=dp.overlap_pct)
+                Gauge("kaito:device_copy_overlap_pct",
+                      "Share of copy/DMA time overlapped with other "
+                      "work", r, fn=dp.copy_overlap_pct)
+                Gauge("kaito:device_idle_pct",
+                      "Idle share of device wall, last window", r,
+                      fn=dp.idle_pct)
+                Gauge("kaito:device_phase_attributed_pct",
+                      "Share of busy device time carrying a kaito/* "
+                      "phase marker", r,
+                      fn=lambda: dp._lastval("phase_attributed_pct"))
+                Gauge("kaito:device_matmul_pct_of_peak_flops",
+                      "Window decode throughput vs chip peak FLOPs "
+                      "(windowed mfu_pct)", r,
+                      fn=lambda: dp._lastval("matmul_pct_of_peak_flops"))
+                Gauge("kaito:device_hbm_pct_of_peak",
+                      "Window weight-stream bandwidth vs chip peak HBM",
+                      r, fn=lambda: dp._lastval("hbm_pct_of_peak"))
+                Gauge("kaito:device_windows_total",
+                      "Devprof windows captured and parsed", r,
+                      fn=lambda: dp.windows_total)
+                Gauge("kaito:device_windows_skipped_total",
+                      "Devprof windows skipped (manual profile active "
+                      "or backend refused)", r,
+                      fn=lambda: dp.windows_skipped)
+                Gauge("kaito:device_window_errors_total",
+                      "Devprof windows whose dump failed to parse", r,
+                      fn=lambda: dp.parse_errors)
             if getattr(engine, "adapter_cache", None) is not None:
                 # dynamic multi-LoRA cache (docs/multi-lora.md):
                 # families exist ONLY with the cache enabled — same

@@ -497,11 +497,12 @@ class TransformerLM:
                                           true_lens, ps, layer=li)
                 cv = write_prefill_tokens(cv, v_new, page_tables, start,
                                           true_lens, ps, layer=li)
-            out = ring_attention(
-                q, k_new, v_new, mesh, axis_name, scale=self._scale,
-                causal=True, sliding_window=window,
-                logit_softcap=a.attn_logit_softcap, head_axis=head_axis,
-                q_tile=q_tile)
+            with jax.named_scope("attention"):
+                out = ring_attention(
+                    q, k_new, v_new, mesh, axis_name, scale=self._scale,
+                    causal=True, sliding_window=window,
+                    logit_softcap=a.attn_logit_softcap,
+                    head_axis=head_axis, q_tile=q_tile)
         elif mode == "prefill_packed":
             # Segment-packed prefill: many fresh prompts share this row;
             # each token carries its own page target (host-computed from

@@ -219,6 +219,11 @@ def quant_linear(x: jax.Array, w: dict) -> jax.Array:
     The branch is trace-time static (shapes + backend + env), so each
     jitted program bakes in exactly one path.
     """
+    with jax.named_scope("quant_matmul"):
+        return _quant_linear(x, w)
+
+
+def _quant_linear(x: jax.Array, w: dict) -> jax.Array:
     mode = _impl_mode()
     lead, K = x.shape[:-1], x.shape[-1]
     rows = 1

@@ -37,10 +37,14 @@ import logging
 import threading
 import time
 from dataclasses import dataclass, field
+from functools import partial
 from typing import Callable, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
+
+from kaito_tpu.engine.devprof import phase_scope
 
 try:  # registers 'bfloat16' & friends with np.dtype()
     import ml_dtypes  # noqa: F401
@@ -201,6 +205,19 @@ def import_kv(cache: KVCache, pages: list[int], payload: bytes,
     return import_arrays(cache, pages, k, v, ks, vs)
 
 
+@partial(jax.jit, static_argnames=("page_axis",))
+@phase_scope("kv_import")
+def _scatter_slab(dst, idx, src, *, page_axis: int):
+    """The import scatter as ONE jitted program so the kv_import phase
+    scope reaches the HLO metadata (an eager ``.at[].set`` dispatches
+    as a bare ``jit(scatter)`` program that no caller-side scope can
+    tag).  jit caches per (shape, page_axis) like every other bucketed
+    program here."""
+    if page_axis == 2:        # stage-major pipeline pool
+        return dst.at[:, :, idx].set(src)
+    return dst.at[:, idx].set(src)
+
+
 def import_arrays(cache: KVCache, pages: list[int], k: np.ndarray,
                   v: np.ndarray,
                   k_scale: Optional[np.ndarray] = None,
@@ -237,22 +254,26 @@ def import_arrays(cache: KVCache, pages: list[int], k: np.ndarray,
         # carry a zero-size V tail, so V must not borrow K's shape)
         S = cache.k.shape[0]
         return KVCache(
-            k=cache.k.at[:, :, idx].set(
-                kj.reshape((S, L // S) + k.shape[1:])),
-            v=cache.v.at[:, :, idx].set(
-                vj.reshape((S, L // S) + v.shape[1:])))
+            k=_scatter_slab(cache.k, idx,
+                            kj.reshape((S, L // S) + k.shape[1:]),
+                            page_axis=2),
+            v=_scatter_slab(cache.v, idx,
+                            vj.reshape((S, L // S) + v.shape[1:]),
+                            page_axis=2))
     new_ks, new_vs = cache.k_scale, cache.v_scale
     if k_scale is not None:
         expect_s = (L, len(pages), cache.k_scale.shape[-1])
         if tuple(k_scale.shape) != expect_s:
             raise ValueError(f"KV scale shape mismatch: got {k_scale.shape}, "
                              f"cache wants {expect_s}")
-        new_ks = cache.k_scale.at[:, idx].set(
-            jnp.asarray(k_scale, jnp.float32))
-        new_vs = cache.v_scale.at[:, idx].set(
-            jnp.asarray(v_scale, jnp.float32))
-    return KVCache(k=cache.k.at[:, idx].set(kj),
-                   v=cache.v.at[:, idx].set(vj),
+        new_ks = _scatter_slab(cache.k_scale, idx,
+                               jnp.asarray(k_scale, jnp.float32),
+                               page_axis=1)
+        new_vs = _scatter_slab(cache.v_scale, idx,
+                               jnp.asarray(v_scale, jnp.float32),
+                               page_axis=1)
+    return KVCache(k=_scatter_slab(cache.k, idx, kj, page_axis=1),
+                   v=_scatter_slab(cache.v, idx, vj, page_axis=1),
                    k_scale=new_ks, v_scale=new_vs)
 
 

@@ -281,6 +281,8 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             self._debug_timeline()
         elif self.path.startswith("/debug/slo"):
             self._json(200, st.slo.snapshot())
+        elif self.path.startswith("/debug/device"):
+            self._debug_device()
         else:
             self._error(404, f"no route {self.path}")
 
@@ -293,27 +295,49 @@ class OpenAIHandler(BaseHTTPRequestHandler):
     def _debug_trace(self):
         """Chrome trace-event JSON of recorded spans (Perfetto-loadable),
         merged across engine groups; `?trace_id=` filters to one
-        request's span tree."""
+        request's span tree.  ``metadata.dropped`` counts ring-overflow
+        evictions so span-tree gaps read as overflow, not as missing
+        instrumentation."""
         from urllib.parse import parse_qs, urlparse
 
         q = parse_qs(urlparse(self.path).query)
         tid = q.get("trace_id", [None])[0]
         spans = []
+        dropped = 0
         for e in self._sub_engines():
             tr = getattr(e, "tracer", None)
             if tr is not None:
                 spans.extend(tr.spans(tid))
-        self._json(200, chrome_trace(spans))
+                dropped += getattr(tr, "dropped", 0)
+        self._json(200, chrome_trace(spans, dropped=dropped))
 
     def _debug_timeline(self):
         """Chrome trace-event JSON of the engine-step flight recorder,
         merged across engine groups."""
         recs = []
+        dropped = 0
         for e in self._sub_engines():
             tl = getattr(e, "timeline", None)
             if tl is not None:
                 recs.extend(tl.records())
-        self._json(200, timeline_trace(recs))
+                dropped += getattr(tl, "dropped", 0)
+        self._json(200, timeline_trace(recs, dropped=dropped))
+
+    def _debug_device(self):
+        """Last-window device-time attribution from the sampling
+        profiler (engine/devprof.py), per engine group.  403 when
+        sampling is off — the devprof-off surface must stay
+        byte-identical to the pre-devprof server."""
+        profs = [(e, getattr(e, "devprof", None))
+                 for e in self._sub_engines()]
+        profs = [(e, p) for e, p in profs if p is not None]
+        if not profs:
+            return self._error(
+                403, "device profiler disabled (--devprof-interval-s)")
+        if len(profs) == 1:
+            return self._json(200, profs[0][1].snapshot())
+        self._json(200, {"groups": [dict(p.snapshot(), group=gi)
+                                    for gi, (_, p) in enumerate(profs)]})
 
     def do_DELETE(self):
         self._intake_trace()
@@ -392,6 +416,10 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                     body = {"status": "started", "dir": prof_dir}
                     if seconds:
                         body["auto_stop_seconds"] = seconds
+                        # armed wall-clock deadline, so a client can
+                        # tell a pending auto-stop from an unbounded
+                        # capture without re-deriving it
+                        body["auto_stop_deadline"] = time.time() + seconds
                     return self._json(200, body)
                 if not active:
                     return self._error(409, "profiler not running")
@@ -1633,12 +1661,23 @@ class _PDServer(ThreadingHTTPServer):
                 if _LOCAL_PD_ENGINES.get(u) is self.state.engine:
                     del _LOCAL_PD_ENGINES[u]
 
+    def _cancel_profile_timer(self):
+        # a pending /start_profile auto-stop must not fire into a
+        # torn-down process (stop_trace on a dead backend)
+        st = getattr(self, "state", None)
+        timer = getattr(st, "_profile_timer", None) if st else None
+        if timer is not None:
+            timer.cancel()
+            st._profile_timer = None
+
     def shutdown(self):
         self._pd_unregister()
+        self._cancel_profile_timer()
         super().shutdown()
 
     def server_close(self):
         self._pd_unregister()
+        self._cancel_profile_timer()
         super().server_close()
 
 
@@ -1940,6 +1979,20 @@ def main(argv=None):
                         "KAITO_GRAMMAR_MAX_STATES", "512")),
                     help="DFA state cap per grammar; each state costs "
                          "O(vocab) bytes in the packed device mask table")
+    ap.add_argument("--devprof-interval-s", type=float,
+                    default=float(os.environ.get(
+                        "KAITO_DEVPROF_INTERVAL_S", "0")),
+                    help="sampled device-time attribution "
+                         "(docs/observability.md): capture a short "
+                         "jax.profiler window this often and fold it "
+                         "into comm/compute/idle buckets on /metrics "
+                         "and /debug/device (0 = off; off keeps the "
+                         "exposition byte-identical and /debug/device "
+                         "answers 403)")
+    ap.add_argument("--devprof-window-s", type=float,
+                    default=float(os.environ.get(
+                        "KAITO_DEVPROF_WINDOW_S", "0.25")),
+                    help="capture length of each sampled devprof window")
     args = ap.parse_args(argv)
 
     import jax
@@ -1997,6 +2050,8 @@ def main(argv=None):
         structured_output=args.structured_output,
         grammar_cache_entries=args.grammar_cache_entries,
         grammar_max_states=args.grammar_max_states,
+        devprof_interval_s=args.devprof_interval_s,
+        devprof_window_s=args.devprof_window_s,
     )
     if args.kaito_config_file:
         cfg = load_config_file(cfg, args.kaito_config_file)

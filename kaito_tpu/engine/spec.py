@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from kaito_tpu.engine.devprof import phase_scope
 from kaito_tpu.engine.kv_cache import create_kv_cache
 from kaito_tpu.engine.model import TransformerLM
 from kaito_tpu.models.registry import (
@@ -329,6 +330,7 @@ class DraftRunner:
             model = self.model
 
             @partial(jax.jit, donate_argnums=(1,))
+            @phase_scope("draft")
             def prefill_ctx(params, cache, tokens, true_lens, page_tables,
                             start_pos):
                 cache, _, _ = model.prefill(params, cache, tokens,
@@ -382,6 +384,7 @@ class DraftRunner:
             model = self.model
 
             @partial(jax.jit, donate_argnums=(1,))
+            @phase_scope("draft")
             def propose(params, cache, tokens, positions, page_tables,
                         active, temperature, keys, gmask, gtrans, grows):
                 temp = jnp.maximum(temperature, 1e-6)[:, None]

@@ -178,6 +178,40 @@ def parse_prefill_pack_annotation(text: str) -> Optional[int]:
     return pack
 
 
+def parse_devprof_annotation(text: str) -> Optional[float]:
+    """Parse the ``kaito-tpu.io/devprof`` Workspace annotation
+    (docs/observability.md): the device-profiler sampling interval in
+    seconds.  Empty input returns None — the server keeps its default
+    (off), so an absent annotation leaves the pod command and metrics
+    exposition byte-identical.  Accepts a positive number (seconds
+    between sampled profile windows); ``0``/``off``/``false`` return
+    None too, an explicit way to say "keep it off".  Raises ValueError
+    otherwise; the workspace controller calls this at plan time so a
+    bad annotation becomes a PlanFailed condition instead of a
+    crash-looping pod.  jax-free on purpose: the controller imports
+    it."""
+    text = (text or "").strip()
+    if not text:
+        return None
+    if text.lower() in ("off", "false", "0", "0.0"):
+        return None
+    try:
+        interval = float(text)
+    except ValueError:
+        raise ValueError(
+            f"devprof annotation must be a sampling interval in "
+            f"seconds (or 'off'), got {text!r}") from None
+    if interval != interval or interval <= 0.0:  # NaN or non-positive
+        raise ValueError(
+            "devprof annotation must be a positive number of seconds")
+    if interval < 1.0:
+        raise ValueError(
+            "devprof annotation must be >= 1.0 seconds — each sample "
+            "captures a full profiler window, so sub-second cadence "
+            "would perturb the workload it measures")
+    return interval
+
+
 def coordinator_address(workspace_name: str, namespace: str) -> str:
     """Pod-0 DNS via the headless service — same convention the
     reference uses for the Ray leader (``pkg/utils/common.go:229``),
@@ -287,6 +321,14 @@ def build_engine_command(
             args += ["--grammar-cache-entries", str(so["cache_entries"])]
         if so["max_states"] is not None:
             args += ["--grammar-max-states", str(so["max_states"])]
+    # sampled device-time attribution (docs/observability.md): off is
+    # the server default (sampling costs device time), so only an
+    # explicit annotation renders — absent keeps the pod command and
+    # the /metrics exposition byte-identical
+    devprof = parse_devprof_annotation(
+        ws.metadata.annotations.get("kaito-tpu.io/devprof", ""))
+    if devprof is not None:
+        args += ["--devprof-interval-s", str(devprof)]
     if config_file:
         args += ["--kaito-config-file", config_file]
     if adapters_dir:

@@ -85,6 +85,13 @@ _ENGINE_GAUGES = {
     "kaito:host_kv_bytes_used": ("host_kv_bytes", "sum"),
     "kaito:adapter_resident": ("adapter_resident", "sum"),
     "kaito:adapter_slots_total": ("adapter_slots_total", "sum"),
+    # sampled device-time attribution (engine/devprof.py): last-window
+    # gauges, present only on replicas running with devprof on — the
+    # fold means over whoever reports, like the adapter families
+    "kaito:device_comm_pct": ("device_comm_pct", "mean"),
+    "kaito:device_comm_compute_overlap_pct": ("device_overlap_pct",
+                                              "mean"),
+    "kaito:device_idle_pct": ("device_idle_pct", "mean"),
 }
 # cumulative counters -> per-replica delta rates at fold time
 _ENGINE_COUNTERS = {
@@ -836,6 +843,12 @@ class FleetTelemetry:
                 rate("prefill_wait_seconds_rate")
                 / rate("prefill_waits_rate")
                 if rate("prefill_waits_rate") > 0 else 0.0),
+            # sampled device-time attribution (engine/devprof.py):
+            # means over the replicas that report (devprof-off
+            # replicas emit no device_* series and don't dilute)
+            "device_comm_pct": fold("device_comm_pct", "mean"),
+            "device_overlap_pct": fold("device_overlap_pct", "mean"),
+            "device_idle_pct": fold("device_idle_pct", "mean"),
         }
         if epps:
             agg["arrival_rate"] = sum(
@@ -1082,6 +1095,18 @@ class FleetTelemetry:
               "Mean staged-to-first-prefill-dispatch wait across the "
               "fleet (seconds)", r,
               labels=("kind", "name"), fn=family("prefill_queue_wait_mean"))
+        Gauge("kaito:fleet_device_comm_pct",
+              "Mean collective share of device wall across replicas "
+              "sampling device profiles (engine/devprof.py)", r,
+              labels=("kind", "name"), fn=family("device_comm_pct"))
+        Gauge("kaito:fleet_device_overlap_pct",
+              "Mean share of collective time hidden behind compute "
+              "across sampling replicas", r,
+              labels=("kind", "name"), fn=family("device_overlap_pct"))
+        Gauge("kaito:fleet_device_idle_pct",
+              "Mean idle share of device wall across sampling "
+              "replicas", r,
+              labels=("kind", "name"), fn=family("device_idle_pct"))
 
         def tenant_family(prefix):
             def _fn():

@@ -99,12 +99,23 @@ class RingTracer:
         self._spans: "collections.deque[Span]" = collections.deque(
             maxlen=max(1, int(capacity)))
         self._lock = threading.Lock()
+        # ring-overflow evictions since start/clear: surfaced as
+        # /debug/trace metadata so a missing span reads as overflow,
+        # not as missing instrumentation
+        self._dropped = 0
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
 
     def record(self, name: str, trace_id: str, t0: float, dur: float,
                **attrs) -> None:
         span = Span(name, trace_id, float(t0), max(0.0, float(dur)),
                     attrs or {})
         with self._lock:
+            if len(self._spans) == self._spans.maxlen:
+                self._dropped += 1
             self._spans.append(span)
 
     @contextmanager
@@ -129,21 +140,25 @@ class RingTracer:
         return out
 
     def chrome_trace(self, trace_id: Optional[str] = None) -> dict:
-        return chrome_trace(self.spans(trace_id))
+        return chrome_trace(self.spans(trace_id), dropped=self.dropped)
 
     def clear(self) -> None:
         with self._lock:
             self._spans.clear()
+            self._dropped = 0
 
     def __len__(self) -> int:
         with self._lock:
             return len(self._spans)
 
 
-def chrome_trace(spans: Iterable[Span]) -> dict:
+def chrome_trace(spans: Iterable[Span],
+                 dropped: Optional[int] = None) -> dict:
     """Chrome trace-event JSON: one complete ("X") event per span, one
     virtual thread per trace id (named via "M" metadata events), so
-    Perfetto lays each request out on its own track."""
+    Perfetto lays each request out on its own track.  ``dropped``
+    (ring-overflow evictions) rides the top-level ``metadata`` key —
+    Perfetto ignores it, diagnosers don't."""
     tids: dict[str, int] = {}
     events: list[dict] = []
     for s in sorted(spans, key=lambda s: (s.t0, -s.dur)):
@@ -157,7 +172,10 @@ def chrome_trace(spans: Iterable[Span]) -> dict:
             "tid": tid, "ts": int(s.t0 * 1e6), "dur": int(s.dur * 1e6),
             "args": {**s.attrs, "trace_id": s.trace_id},
         })
-    return {"traceEvents": events, "displayTimeUnit": "ms"}
+    doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+    if dropped is not None:
+        doc["metadata"] = {"dropped": int(dropped)}
+    return doc
 
 
 def format_span_tree(spans: Iterable[Span]) -> str:
@@ -189,11 +207,19 @@ class StepTimeline:
         self._records: "collections.deque[dict]" = collections.deque(
             maxlen=max(1, int(capacity)))
         self._lock = threading.Lock()
+        self._dropped = 0
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
 
     def add(self, t0: float, dur: float, **fields) -> None:
         rec = {"ts": float(t0), "dur": max(0.0, float(dur))}
         rec.update(fields)
         with self._lock:
+            if len(self._records) == self._records.maxlen:
+                self._dropped += 1
             self._records.append(rec)
 
     def records(self) -> list[dict]:
@@ -201,11 +227,12 @@ class StepTimeline:
             return list(self._records)
 
     def chrome_trace(self) -> dict:
-        return timeline_trace(self.records())
+        return timeline_trace(self.records(), dropped=self.dropped)
 
     def clear(self) -> None:
         with self._lock:
             self._records.clear()
+            self._dropped = 0
 
     def __len__(self) -> int:
         with self._lock:
@@ -237,10 +264,12 @@ def decode_gap_summary(records: Iterable[dict]) -> tuple[float, float]:
             1e3 * total_gap / len(gaps))
 
 
-def timeline_trace(records: Iterable[dict]) -> dict:
+def timeline_trace(records: Iterable[dict],
+                   dropped: Optional[int] = None) -> dict:
     """Chrome trace-event JSON for the step timeline: an "X" slice per
     step (args carry the full record) plus "C" counter tracks for batch
-    occupancy and KV page usage, so Perfetto graphs them over time."""
+    occupancy and KV page usage, so Perfetto graphs them over time.
+    ``dropped`` rides ``metadata`` like chrome_trace's."""
     events: list[dict] = [
         {"name": "thread_name", "ph": "M", "pid": 1, "tid": 0,
          "args": {"name": "engine.step"}}]
@@ -258,4 +287,7 @@ def timeline_trace(records: Iterable[dict]) -> dict:
         events.append({"name": "kv_pages_used", "ph": "C", "pid": 1,
                        "tid": 0, "ts": ts,
                        "args": {"used": rec.get("kv_pages_used", 0)}})
-    return {"traceEvents": events, "displayTimeUnit": "ms"}
+    doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+    if dropped is not None:
+        doc["metadata"] = {"dropped": int(dropped)}
+    return doc

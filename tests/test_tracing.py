@@ -131,6 +131,31 @@ def test_step_timeline_and_trace():
     assert timeline_trace([])["traceEvents"][0]["ph"] == "M"
 
 
+def test_ring_overflow_surfaces_dropped_in_trace_metadata():
+    """Evicted records are counted and ride the Chrome-export
+    ``metadata`` key, so a missing span in /debug/trace or
+    /debug/timeline reads as ring overflow, not as missing
+    instrumentation."""
+    tr = RingTracer(capacity=3)
+    for i in range(5):
+        tr.record(f"s{i}", "t", float(i), 0.1)
+    assert tr.dropped == 2
+    assert tr.chrome_trace()["metadata"] == {"dropped": 2}
+    tr.clear()
+    assert tr.dropped == 0
+
+    tl = StepTimeline(capacity=2)
+    for i in range(5):
+        tl.add(float(i), 0.01, running=1)
+    assert tl.dropped == 3
+    assert tl.chrome_trace()["metadata"] == {"dropped": 3}
+    tl.clear()
+    assert tl.dropped == 0
+    # explicit dropped=None keeps the export shape unchanged
+    assert "metadata" not in chrome_trace([])
+    assert "metadata" not in timeline_trace([])
+
+
 # ---------------------------------------------------------------------------
 # router observability against stub backends (fast; no engine)
 # ---------------------------------------------------------------------------
