@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: all native unit-test unit-test-fast unit-test-slow engine-test rag-test chaos kvq wquant kvpool lora structured obs devprof slo fleet autoscale spec qos asyncloop prefill bench serve manager epp clean
+.PHONY: all native unit-test unit-test-fast unit-test-slow engine-test rag-test chaos kvq wquant kvpool lora structured obs devprof slo fleet autoscale spec qos asyncloop prefill overlap bench serve manager epp clean
 
 all: native
 
@@ -82,7 +82,8 @@ structured:
 obs:
 	$(PYTHON) -m pytest tests/test_tracing.py tests/test_metrics_format.py \
 	  tests/test_slo.py tests/test_controllers.py tests/test_fleet.py \
-	  tests/test_prefill_pack.py tests/test_devprof.py -q -m "not slow"
+	  tests/test_prefill_pack.py tests/test_devprof.py \
+	  tests/test_comm_overlap.py -q -m "not slow"
 
 # device-time attribution suite (docs/observability.md "Device-time
 # attribution"): bucket classifier, XPlane wire + chrome-trace parsers,
@@ -93,6 +94,15 @@ obs:
 # /debug/device vs /metrics agreement, 403 when off)
 devprof:
 	$(PYTHON) -m pytest tests/test_devprof.py -q
+
+# collective-compute overlap suite (docs/multichip.md): ring/reference
+# parity, prefetch bitwise pin, annotation plumbing (fast tier), then
+# the TP=2 greedy A-B smoke on a 4-device virtual CPU mesh (slow tier)
+overlap:
+	$(PYTHON) -m pytest tests/test_comm_overlap.py -q -m "not slow"
+	XLA_FLAGS=--xla_force_host_platform_device_count=4 $(PYTHON) -m pytest \
+	  "tests/test_comm_overlap.py::test_tp_greedy_bit_equivalent_on_vs_off[2]" \
+	  tests/test_comm_overlap.py::test_gate_off_byte_identical_exposition -q
 
 # SLO watchdog suite alone (docs/observability.md "Control plane")
 slo:

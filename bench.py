@@ -224,6 +224,13 @@ def orchestrate(args):
                 merged.update(res)
             else:
                 merged.setdefault("errors", []).append(res["error"])
+        # same for the multi-chip decode ladder: virtual mesh, CPU-only
+        if not args.skip_multichip_bench and remaining() > 90:
+            res = run_phase("multichip", [], min(remaining(), 500.0))
+            if "error" not in res:
+                merged.update(res)
+            else:
+                merged.setdefault("errors", []).append(res["error"])
         save_partial()
         with lock:
             print(json.dumps(merged), flush=True)
@@ -466,6 +473,16 @@ def orchestrate(args):
     if not args.skip_cp_bench and remaining() > 120:
         res = run_phase("cp", ["--cp-tokens", str(args.cp_tokens)],
                         min(remaining(), 600.0))
+        if "error" not in res:
+            merged.update(res)
+        else:
+            merged.setdefault("errors", []).append(res["error"])
+        save_partial()
+
+    # --- phase: multi-chip decode ladder (virtual 8-dev mesh): tp/pp
+    # rows + the comm-overlap A-B leg (docs/multichip.md) ---
+    if not args.skip_multichip_bench and remaining() > 90:
+        res = run_phase("multichip", [], min(remaining(), 500.0))
         if "error" not in res:
             merged.update(res)
         else:
@@ -1461,6 +1478,91 @@ def phase_cp(args):
     print(json.dumps(out), flush=True)
 
 
+def phase_multichip(args):
+    """Multi-chip decode ladder on the virtual 8-device mesh (always
+    CPU: the ring needs >= 2 devices and the box has one chip).  Rows:
+    single-chip baseline, tp=2 with the comm-overlap gate off and on
+    (the A-B leg for docs/multichip.md), and pp=2.  Each row carries
+    the schema-stable device-time attribution columns (comm_pct /
+    overlap_pct, 0.0 when the profiler has no sample) plus one
+    overlap_speedup column — on CPU the virtual devices share the core
+    so the speedup mainly proves the gate's plumbing and parity; the
+    latency win needs real ICI."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    _init_jax(force_cpu=True)
+
+    import threading
+
+    from kaito_tpu.engine.config import EngineConfig
+    from kaito_tpu.engine.engine import InferenceEngine, SamplingParams
+
+    steps = min(args.decode_steps or 64, 64)
+    base = dict(model="tiny-llama-test", max_model_len=256, page_size=16,
+                max_num_seqs=2, dtype="float32", kv_dtype="float32",
+                prefill_buckets=(32,), seed=0,
+                devprof_interval_s=3600.0,   # sampled manually per row
+                devprof_window_s=0.25)
+    prompt = [5, 6, 7, 8]
+    p = SamplingParams(max_tokens=steps, temperature=0.0, ignore_eos=True)
+    rows = (("tp1", {}),
+            ("tp2_off", dict(tensor_parallel=2, comm_overlap=False)),
+            ("tp2_on", dict(tensor_parallel=2, comm_overlap=True)),
+            ("pp2", dict(pipeline_parallel=2)))
+    out: dict = {}
+    toks_by_row: dict = {}
+    for name, extra in rows:
+        try:
+            eng = InferenceEngine(EngineConfig(**base, **extra))
+        except Exception as e:   # a broken layout costs its row only
+            out.setdefault("multichip_errors", []).append(f"{name}: {e}")
+            continue
+        eng.start()
+        try:
+            for _warm in range(2):   # second run is compile-free
+                t0 = time.monotonic()
+                toks = list(eng.submit(list(prompt), p).stream())
+                dt = time.monotonic() - t0
+            if len(toks) != steps:
+                out.setdefault("multichip_errors", []).append(
+                    f"{name}: decode produced {len(toks)}/{steps} tokens")
+                continue
+            toks_by_row[name] = toks
+            # one profiler window around a burn decode, AFTER the timed
+            # run (sampling perturbs the number being measured) -> real
+            # comm attribution where the backend traces collectives
+            if eng.devprof is not None:
+                def _burn():
+                    for _ in eng.submit(list(prompt), p).stream():
+                        pass
+
+                t = threading.Thread(target=_burn)
+                t.start()
+                eng.devprof.sample_window()
+                t.join()
+        finally:
+            eng.stop()
+        out[f"multichip_decode_tok_s_{name}"] = round(steps / dt, 1)
+        pcts = _devprof_pcts(eng)
+        out[f"multichip_comm_pct_{name}"] = pcts["comm_pct"]
+        out[f"multichip_overlap_pct_{name}"] = pcts["overlap_pct"]
+        log(f"multichip {name}: {steps / dt:.1f} tok/s "
+            f"comm={pcts['comm_pct']}% overlap={pcts['overlap_pct']}%")
+    parity = ("tp1" in toks_by_row
+              and all(t == toks_by_row["tp1"]
+                      for t in toks_by_row.values()))
+    out["multichip_parity"] = bool(parity)
+    if not parity:
+        out["error"] = "multichip: greedy output diverged across rows"
+    on = out.get("multichip_decode_tok_s_tp2_on", 0.0)
+    off = out.get("multichip_decode_tok_s_tp2_off", 0.0)
+    out["multichip_overlap_speedup"] = (round(on / off, 2)
+                                        if on and off else 0.0)
+    print(json.dumps(out), flush=True)
+
+
 def phase_pd(args):
     """P/D disaggregation hand-off: measure KV-transfer latency from a
     prefill engine to a decode engine at 2k/8k contexts (chunked,
@@ -1759,7 +1861,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--phase", default="",
                     choices=["", "watch", "probe", "raw", "serve",
-                             "int8_8b", "pd", "cp", "prefix",
+                             "int8_8b", "pd", "cp", "multichip", "prefix",
                              "prefill_burst", "kvpool",
                              "lora", "structured", "wquant_quality"])
     ap.add_argument("--cp-tokens", type=int, default=8192)
@@ -1767,6 +1869,7 @@ def main():
                     help="cp phase: measure only the per-chip shard-"
                          "attention critical path (the cheap >=32k leg)")
     ap.add_argument("--skip-cp-bench", action="store_true")
+    ap.add_argument("--skip-multichip-bench", action="store_true")
     ap.add_argument("--spec-draft", default="",
                     help="draft preset for the speculative serve leg "
                          "('self' = the benched model drafts for "
@@ -1838,6 +1941,8 @@ def main():
         phase_structured(args)
     elif args.phase == "cp":
         phase_cp(args)
+    elif args.phase == "multichip":
+        phase_multichip(args)
     else:
         orchestrate(args)
 

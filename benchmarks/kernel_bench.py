@@ -10,7 +10,23 @@ Usage:  python benchmarks/kernel_bench.py [--decode] [--prefill] [--iters N]
 from __future__ import annotations
 
 import argparse
+import os
+import sys
 import time
+
+# make `python benchmarks/kernel_bench.py` work from anywhere (the
+# script dir, not the repo root, is what python puts on sys.path)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if ("--overlap-ring" in sys.argv
+        and "xla_force_host_platform_device_count"
+        not in os.environ.get("XLA_FLAGS", "")):
+    # the ring needs >= 2 devices; give the CPU backend a virtual
+    # 4-chip mesh BEFORE jax initializes (the flag only affects the
+    # host platform, so it is a no-op on a real multi-chip slice)
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=4").strip()
 
 import jax
 import jax.numpy as jnp
@@ -214,6 +230,69 @@ def bench_prefill(iters: int) -> None:
               f"{causal_flops / dt / 1e12:5.1f} TFLOP/s (causal)")
 
 
+def bench_overlap_ring(iters: int) -> None:
+    """Pipelined ring collectives (ops/overlap_collectives.py): parity
+    vs the pure-lax psum reference and per-hop ring traffic.  Runs on
+    any >= 2-device mesh — CPU CI gets one via the --overlap-ring
+    XLA_FLAGS hook above, so the hop structure the TPU executes is
+    exactly what this row times."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from kaito_tpu.engine.ops.overlap_collectives import (
+        all_gather_matmul, overlap_linear)
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        print("overlap-ring: skipped (needs >= 2 devices; run with "
+              "XLA_FLAGS=--xla_force_host_platform_device_count=4)")
+        return
+    n = 4 if len(devs) >= 4 else 2
+    mesh = Mesh(np.array(devs[:n]), ("tensor",))
+    dtype = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+    rows, K, N = 8, 2048, 2048
+    kx, kw = jax.random.split(jax.random.PRNGKey(2))
+    x = jax.random.normal(kx, (rows, K), dtype)
+    w = jax.random.normal(kw, (K, N), dtype)
+
+    def traced(mode):
+        # KAITO_COMM_OVERLAP is read at TRACE time: pin it around the
+        # warm-up call so each jit bakes in exactly one body
+        prev = os.environ.get("KAITO_COMM_OVERLAP")
+        os.environ["KAITO_COMM_OVERLAP"] = mode
+        try:
+            f = jax.jit(lambda x, w: overlap_linear(x, w, mesh))
+            f(x, w).block_until_ready()
+        finally:
+            if prev is None:
+                os.environ.pop("KAITO_COMM_OVERLAP", None)
+            else:
+                os.environ["KAITO_COMM_OVERLAP"] = prev
+        return f
+
+    ring, ref = traced("ring"), traced("jax")
+    err = float(jnp.max(jnp.abs(ring(x, w).astype(jnp.float32)
+                                - ref(x, w).astype(jnp.float32))))
+    print(f"overlap-ring parity vs psum reference: max abs err = {err:.5f}")
+    # per-device ring traffic: (n-1) reduce-scatter hops + (n-1)
+    # all-gather hops, each moving one [rows, N/n] partial
+    hop_bytes = rows * (N // n) * jnp.dtype(dtype).itemsize
+    ring_bytes = 2 * (n - 1) * hop_bytes
+    for name, fn in (("ring", ring), ("psum-ref", ref)):
+        dt = _timeit(fn, x, w, iters=iters)
+        print(f"overlap[{name}]: {dt * 1e3:8.3f} ms/call, "
+              f"{ring_bytes / dt / 1e9:6.2f} GB/s ring traffic "
+              f"({n - 1} hops x {hop_bytes} B x 2 phases)")
+    # the column-parallel dual: x chunks rotate while each device
+    # matmuls the arrived chunk against its out-shard's row block
+    ag = jax.jit(lambda x, w: all_gather_matmul(x, w, mesh))
+    err = float(jnp.max(jnp.abs(ag(x, w).astype(jnp.float32)
+                                - (x @ w).astype(jnp.float32))))
+    dt = _timeit(ag, x, w, iters=iters)
+    print(f"overlap[ag+mm]: {dt * 1e3:8.3f} ms/call, "
+          f"max abs err = {err:.5f}")
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--decode", action="store_true")
@@ -221,10 +300,12 @@ def main() -> None:
     ap.add_argument("--gemv-int8", action="store_true")
     ap.add_argument("--gemv-int4", action="store_true")
     ap.add_argument("--prefill", action="store_true")
+    ap.add_argument("--overlap-ring", action="store_true")
     ap.add_argument("--iters", type=int, default=50)
     args = ap.parse_args()
     run_all = not (args.decode or args.prefill or args.decode_int8
-                   or args.gemv_int8 or args.gemv_int4)
+                   or args.gemv_int8 or args.gemv_int4
+                   or args.overlap_ring)
     print(f"backend: {jax.default_backend()}, device: {jax.devices()[0]}")
     if args.decode or run_all:
         bench_decode(args.iters)
@@ -236,6 +317,8 @@ def main() -> None:
         bench_gemv_quant(args.iters, "int4")
     if args.prefill or run_all:
         bench_prefill(args.iters)
+    if args.overlap_ring or run_all:
+        bench_overlap_ring(args.iters)
 
 
 if __name__ == "__main__":

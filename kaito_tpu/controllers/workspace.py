@@ -127,8 +127,8 @@ def plan_workspace(store: Store, ws: Workspace):
     # code the renderer runs, so plan-time acceptance == render-time
     # acceptance (docs/multi-lora.md)
     from kaito_tpu.manifests.inference import (
-        parse_adapters_annotation, parse_devprof_annotation,
-        parse_structured_output_annotation)
+        parse_adapters_annotation, parse_comm_overlap_annotation,
+        parse_devprof_annotation, parse_structured_output_annotation)
     try:
         parse_adapters_annotation(ws.metadata.annotations.get(
             "kaito-tpu.io/adapters", ""))
@@ -142,6 +142,15 @@ def plan_workspace(store: Store, ws: Workspace):
             "kaito-tpu.io/devprof", ""))
     except ValueError as e:
         raise ValueError(f"invalid kaito-tpu.io/devprof annotation: {e}")
+    # a malformed comm-overlap gate fails the plan the same way — the
+    # exact parse the renderer runs, so plan-time acceptance ==
+    # render-time acceptance (docs/multichip.md)
+    try:
+        parse_comm_overlap_annotation(ws.metadata.annotations.get(
+            "kaito-tpu.io/comm-overlap", ""))
+    except ValueError as e:
+        raise ValueError(
+            f"invalid kaito-tpu.io/comm-overlap annotation: {e}")
     # a malformed structured-output document fails the plan the same
     # way — again the exact parse the renderer runs, so plan-time
     # acceptance == render-time acceptance (docs/structured-output.md)

@@ -76,6 +76,16 @@ class EngineConfig:
     # True/False force it.  Off keeps the synchronous loop
     # byte-identical to before (no new metric families).
     async_dispatch: Optional[bool] = None
+    # collective-compute overlap for TP decode (docs/multichip.md):
+    # decompose the row-parallel projections' output all-reduce into
+    # pipelined reduce-scatter + all-gather ring hops (ppermute), each
+    # overlapped with the next chunk's partial matmul, and stream the
+    # next layer's quantized slab into VMEM while the hops drain.
+    # None = follow KAITO_COMM_OVERLAP (off when unset); True/False
+    # force it.  Off keeps dispatch, numerics and the /metrics
+    # exposition byte-identical; the gate only ever engages on a
+    # TP>=2 mesh (never PP/single-chip, never prefill).
+    comm_overlap: Optional[bool] = None
     # n-gram (prompt-lookup) speculative decoding: propose up to N
     # continuation tokens by matching the trailing n-gram against the
     # sequence's own context, verify them in ONE windowed dispatch, and

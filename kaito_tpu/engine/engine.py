@@ -315,6 +315,42 @@ class InferenceEngine:
                     logger.info("context-parallel prefill: sequence=%d "
                                 "(head_axis=%s)", sp, head_axis)
 
+        # collective-compute overlap (docs/multichip.md): pipelined
+        # ring decomposition of the TP decode all-reduces + layer-ahead
+        # slab prefetch.  Off by default — the gate-off path keeps
+        # dispatch, numerics and the exposition byte-identical; None
+        # follows KAITO_COMM_OVERLAP (which doubles as the trace-time
+        # ring/jax implementation override, overlap_collectives.py).
+        # Only a flat TP>=2 mesh qualifies: PP drives decode through
+        # its own executor, CP only reshapes prefill, single-chip has
+        # no collective to hide.
+        co = cfg.comm_overlap if getattr(cfg, "comm_overlap", None) \
+            is not None else (os.environ.get("KAITO_COMM_OVERLAP", "")
+                              .strip().lower()
+                              not in ("", "0", "false", "off"))
+        self.comm_overlap = False
+        if co and self.mesh is not None and self.pp_exec is None:
+            from kaito_tpu.parallel.sharding import SERVE_RULES, ring_axis
+
+            ax = ring_axis(SERVE_RULES)
+            tp_sz = dict(self.mesh.shape).get(ax, 1) if ax else 1
+            emb = arch.hidden_size
+            if (tp_sz >= 2 and emb % tp_sz == 0
+                    and arch.num_heads % tp_sz == 0
+                    and arch.intermediate_size % tp_sz == 0):
+                self.comm_overlap = True
+                self.model.overlap = (self.mesh, ax)
+                logger.info("collective-compute overlap: ring TP decode "
+                            "(%s=%d, %d ppermute hops per projection)",
+                            ax, tp_sz, tp_sz - 1)
+            else:
+                logger.warning(
+                    "comm-overlap requested but not applicable "
+                    "(ring axis=%s size=%d, embed=%d heads=%d "
+                    "intermediate=%d must all divide); keeping the "
+                    "unoverlapped path", ax, tp_sz, emb,
+                    arch.num_heads, arch.intermediate_size)
+
         if not cfg.max_model_len:
             cfg.max_model_len = min(self.md.max_model_len, 8192)
         self.pages_per_seq = cfg.pages_per_seq

@@ -67,6 +67,23 @@ def _layer_groups(arch: ModelArch) -> tuple[LayerGroup, ...]:
     return (LayerGroup("dense", 0, arch.num_layers, False),)
 
 
+def _prefetch_stack(stack: dict):
+    """Layer-ahead slabs for the comm-overlap decode scan
+    (docs/multichip.md): the QUANTIZED o/down planes rolled one layer
+    forward on the stack axis, so the scan body at layer L slices layer
+    L+1's slab and hands it to the fused kernel's prefetch stream.  The
+    roll wraps the last layer to layer 0 — which is exactly the slab
+    the NEXT decode step reads first.  bf16 stacks (no q planes) add
+    nothing: prefetch is a quantized-weights optimization and the plain
+    path stays untouched."""
+    out = {}
+    for name in ("o", "down"):
+        w = stack.get(name)
+        if isinstance(w, dict) and ("q8" in w or "q4" in w):
+            out[name] = {k: jnp.roll(v, -1, axis=0) for k, v in w.items()}
+    return out or None
+
+
 class TransformerLM:
     """Functional model: all state lives in explicit params/cache trees."""
 
@@ -81,6 +98,12 @@ class TransformerLM:
         # (Mesh, axis, head_axis|None, q_tile) => context-parallel
         # serving prefill (mode "prefill_cp"); set by the engine
         self.cp = None
+        # (Mesh, axis) => collective-compute overlap for TP decode
+        # (docs/multichip.md); set by the engine when the comm-overlap
+        # gate resolves on.  Only the DECODE mode's row-parallel
+        # projections (attention-out, MLP-down) route through the
+        # pipelined ring — prefill/CP/PP paths never read this.
+        self.overlap = None
         self.moe_impl = "dense"     # "dense" | "ragged" (grouped matmul)
         self.groups = _layer_groups(arch)
         self.vocab_padded = -(-arch.vocab_size // VOCAB_ALIGN) * VOCAB_ALIGN
@@ -427,14 +450,16 @@ class TransformerLM:
 
     def _mlp(self, x: jax.Array, p: dict, moe: bool,
              lora: Optional[dict] = None,
-             lora_ids: Optional[jax.Array] = None) -> jax.Array:
+             lora_ids: Optional[jax.Array] = None,
+             overlap=None, pf_down=None) -> jax.Array:
         if moe:
             B, T, E = x.shape
             fn = nn.moe_mlp_ragged if self.moe_impl == "ragged" else nn.moe_mlp
             y = fn(x.reshape(B * T, E), p, self.arch)
             return y.reshape(B, T, E)
         return nn.mlp(x, p, self.arch, self.lora_scaling,
-                      serve_lora=lora, lora_ids=lora_ids)
+                      serve_lora=lora, lora_ids=lora_ids,
+                      overlap=overlap, pf_down=pf_down)
 
     def _norm(self, x, p, name):
         if self.arch.norm_type == "layernorm":
@@ -444,7 +469,7 @@ class TransformerLM:
     def _layer(self, x, p, ck, cv, li, window, moe, mode, *,
                positions, page_tables, lengths, true_lens, active,
                start_pos=None, lora=None, lora_ids=None,
-               ks=None, vs=None, packed=None):
+               ks=None, vs=None, packed=None, pf=None):
         """One transformer block. Returns (x, ck, cv, ks, vs).
 
         ``ck``/``cv`` are the FULL layer-group page pools
@@ -600,20 +625,35 @@ class TransformerLM:
                     layer=li, k_scale=ks, v_scale=vs)
             out = out[:, None]
         o_in = out.reshape(B, T, a.num_heads * a.head_dim)
-        attn_out = nn.linear(o_in, p["o"]) + nn.lora_delta(o_in, p, "o", self.lora_scaling) \
+        # collective-compute overlap (docs/multichip.md): the DECODE
+        # step's row-parallel attention-out projection routes through
+        # the pipelined ring; every prefill mode and the gate-off path
+        # keep the plain linear (implicit GSPMD all-reduce) unchanged
+        ov = self.overlap if mode == "decode" else None
+        if ov is not None:
+            from kaito_tpu.engine.ops.overlap_collectives import (
+                overlap_linear)
+
+            o_proj = overlap_linear(o_in, p["o"], ov[0], axis_name=ov[1],
+                                    prefetch=(pf or {}).get("o"))
+        else:
+            o_proj = nn.linear(o_in, p["o"])
+        attn_out = o_proj + nn.lora_delta(o_in, p, "o", self.lora_scaling) \
             + nn.multi_lora_delta(o_in, lora, "o", lora_ids)
         if "o_bias" in p:
             attn_out = attn_out + p["o_bias"]
 
         if a.parallel_residual:
-            mlp_out = self._mlp(h, p, moe, lora=lora, lora_ids=lora_ids)
+            mlp_out = self._mlp(h, p, moe, lora=lora, lora_ids=lora_ids,
+                                overlap=ov, pf_down=(pf or {}).get("down"))
             return x + attn_out + mlp_out, ck, cv, ks, vs
 
         if a.pre_post_norm:
             attn_out = self._norm(attn_out, p, "post_attn_norm")
         x = x + attn_out
         h2 = self._norm(x, p, "mlp_norm")
-        mlp_out = self._mlp(h2, p, moe, lora=lora, lora_ids=lora_ids)
+        mlp_out = self._mlp(h2, p, moe, lora=lora, lora_ids=lora_ids,
+                            overlap=ov, pf_down=(pf or {}).get("down"))
         if a.pre_post_norm:
             mlp_out = self._norm(mlp_out, p, "post_mlp_norm")
         return x + mlp_out, ck, cv, ks, vs
@@ -662,19 +702,32 @@ class TransformerLM:
             # stack (None for groups without one, e.g. MoE)
             lora_g = serve_lora.get(g.name) if serve_lora else None
             has_lora = bool(lora_g)
+            # comm-overlap decode: the next layer's quantized o/down
+            # slabs ride the scan as one more xs stream (rolled stack,
+            # docs/multichip.md) feeding the kernel's prefetch DMA.
+            # Gate off (or non-decode, or bf16): no extra stream — the
+            # scan signature and trace are byte-identical to before.
+            pf_g = (_prefetch_stack(stack)
+                    if self.overlap is not None and mode == "decode"
+                    else None)
+            has_pf = pf_g is not None
 
-            def body(carry, xs, moe=g.moe, has_lora=has_lora):
+            def body(carry, xs, moe=g.moe, has_lora=has_lora,
+                     has_pf=has_pf):
                 h, ck_g, cv_g, ks_g, vs_g = carry
                 items = list(xs)
                 li, p = items[0], items[1]
-                lora_l = items[2] if has_lora else None
+                k = 2
+                lora_l = items[k] if has_lora else None
+                k += int(has_lora)
+                pf_l = items[k] if has_pf else None
                 window = items[-1] if flags is not None else None
                 h, ck_g, cv_g, ks_g, vs_g = self._layer(
                     h, p, ck_g, cv_g, li, window, moe, mode,
                     positions=positions, page_tables=page_tables,
                     lengths=lengths, true_lens=true_lens, active=active,
                     start_pos=start_pos, lora=lora_l, lora_ids=adapter_ids,
-                    ks=ks_g, vs=vs_g, packed=packed)
+                    ks=ks_g, vs=vs_g, packed=packed, pf=pf_l)
                 return (h, ck_g, cv_g, ks_g, vs_g), None
 
             # scan length follows the actual stack: pipeline stages pass
@@ -684,6 +737,8 @@ class TransformerLM:
             xs = (jnp.arange(Lg, dtype=jnp.int32), stack)
             if has_lora:
                 xs = xs + (lora_g,)
+            if has_pf:
+                xs = xs + (pf_g,)
             if flags is not None:
                 pat = self.arch.sliding_window_pattern
                 if Lg != g.count and pat and Lg % pat:

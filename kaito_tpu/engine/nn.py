@@ -275,8 +275,19 @@ def activation(x: jax.Array, name: str) -> jax.Array:
 
 def mlp(x: jax.Array, p: dict, arch: ModelArch, lora_scaling: float = 0.0,
         serve_lora: Optional[dict] = None,
-        lora_ids: Optional[jax.Array] = None) -> jax.Array:
-    """Gated (SwiGLU/GeGLU) or classic 2-matrix MLP."""
+        lora_ids: Optional[jax.Array] = None,
+        overlap=None, pf_down: Optional[dict] = None) -> jax.Array:
+    """Gated (SwiGLU/GeGLU) or classic 2-matrix MLP.
+
+    ``overlap`` is the engine's (mesh, axis) comm-overlap handle
+    (docs/multichip.md): when set, the row-parallel DOWN projection —
+    the one whose output all-reduce sits on the TP decode critical
+    path — routes through the pipelined ring instead of the implicit
+    GSPMD collective, with ``pf_down`` (the next layer's quantized
+    down slab) riding the same call as the layer-ahead prefetch.  The
+    LoRA deltas stay on the plain path: they are rank-r rescues whose
+    collectives are noise next to the main projection's.
+    """
     if arch.gated_mlp:
         gate = activation(linear(x, p["gate"]) + lora_delta(x, p, "gate", lora_scaling)
                           + multi_lora_delta(x, serve_lora, "gate", lora_ids),
@@ -290,7 +301,15 @@ def mlp(x: jax.Array, p: dict, arch: ModelArch, lora_scaling: float = 0.0,
         if "up_bias" in p:
             h = h + p["up_bias"]
         h = activation(h, arch.hidden_act)
-    out = linear(h, p["down"]) + lora_delta(h, p, "down", lora_scaling) \
+    if overlap is not None:
+        from kaito_tpu.engine.ops.overlap_collectives import overlap_linear
+
+        mesh, axis = overlap
+        down = overlap_linear(h, p["down"], mesh, axis_name=axis,
+                              prefetch=pf_down)
+    else:
+        down = linear(h, p["down"])
+    out = down + lora_delta(h, p, "down", lora_scaling) \
         + multi_lora_delta(h, serve_lora, "down", lora_ids)
     if "down_bias" in p:
         out = out + p["down_bias"]

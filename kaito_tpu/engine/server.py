@@ -1898,6 +1898,16 @@ def main(argv=None):
                          "pipeline overlapping host postprocess with device "
                          "compute (default off; off keeps the synchronous "
                          "loop and /metrics byte-identical)")
+    ap.add_argument("--comm-overlap", action="store_true",
+                    default=os.environ.get("KAITO_COMM_OVERLAP", "")
+                    .strip().lower() not in ("", "0", "false", "off"),
+                    help="collective-compute overlap for TP decode "
+                         "(docs/multichip.md): pipelined ring "
+                         "reduce-scatter/all-gather in place of the "
+                         "monolithic all-reduce, plus layer-ahead "
+                         "quantized-slab prefetch (default off; off "
+                         "keeps dispatch, numerics and /metrics "
+                         "byte-identical; ignored off a TP>=2 mesh)")
     ap.add_argument("--kaito-disable-rate-limit", action="store_true")
     ap.add_argument("--enable-prefix-caching", dest="enable_prefix_caching",
                     action="store_true", default=True,
@@ -2030,6 +2040,7 @@ def main(argv=None):
         kv_pool_enabled=args.kv_pool,
         kv_pool_bytes=args.kv_pool_bytes,
         async_dispatch=args.async_dispatch,
+        comm_overlap=args.comm_overlap,
         disable_rate_limit=args.kaito_disable_rate_limit,
         enable_prefix_caching=args.enable_prefix_caching,
         host_kv_offload_bytes=int(

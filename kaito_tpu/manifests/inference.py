@@ -212,6 +212,29 @@ def parse_devprof_annotation(text: str) -> Optional[float]:
     return interval
 
 
+def parse_comm_overlap_annotation(text: str) -> Optional[bool]:
+    """Parse the ``kaito-tpu.io/comm-overlap`` Workspace annotation
+    (docs/multichip.md): the collective-compute overlap gate for TP
+    decode.  Empty input returns None — the server keeps its default
+    (off), so an absent annotation leaves the pod command, dispatch and
+    metrics exposition byte-identical.  Accepts the usual boolean
+    spellings (true/1/on/enabled, false/0/off/disabled).  Raises
+    ValueError otherwise; the workspace controller calls this at plan
+    time so a bad annotation becomes a PlanFailed condition instead of
+    a crash-looping pod.  jax-free on purpose: the controller imports
+    it."""
+    text = (text or "").strip().lower()
+    if not text:
+        return None
+    if text in ("true", "1", "on", "enabled"):
+        return True
+    if text in ("false", "0", "off", "disabled"):
+        return False
+    raise ValueError(
+        f"comm-overlap annotation must be a boolean "
+        f"(true/1/on/enabled or false/0/off/disabled), got {text!r}")
+
+
 def coordinator_address(workspace_name: str, namespace: str) -> str:
     """Pod-0 DNS via the headless service — same convention the
     reference uses for the Ray leader (``pkg/utils/common.go:229``),
@@ -329,6 +352,15 @@ def build_engine_command(
         ws.metadata.annotations.get("kaito-tpu.io/devprof", ""))
     if devprof is not None:
         args += ["--devprof-interval-s", str(devprof)]
+    # collective-compute overlap (docs/multichip.md): off is the server
+    # default, so only an explicit opt-in renders — absent (or an
+    # explicit off) keeps the pod command byte-identical.  The server
+    # ignores the flag off a TP>=2 mesh, so rendering it on a plan
+    # without a tensor axis is harmless, not a failure.
+    overlap = parse_comm_overlap_annotation(
+        ws.metadata.annotations.get("kaito-tpu.io/comm-overlap", ""))
+    if overlap:
+        args += ["--comm-overlap"]
     if config_file:
         args += ["--kaito-config-file", config_file]
     if adapters_dir:

@@ -77,6 +77,36 @@ SERVE_RULES = PartitionRules({
     "seq": None,
 })
 
+# Collective-compute overlap (docs/multichip.md): the decode linears
+# whose CONTRACTION axis is tensor-sharded — attention-out contracts
+# "heads", MLP-down contracts "intermediate" — are the row-parallel
+# projections whose output all-reduce the pipelined ring decomposes.
+ROW_PARALLEL_CONTRACTIONS: tuple[str, ...] = ("heads", "intermediate")
+
+
+def ring_axis(rules: PartitionRules,
+              contractions: Sequence[str] = ROW_PARALLEL_CONTRACTIONS
+              ) -> Optional[str]:
+    """Mesh axis the pipelined decode collectives ring over, or None.
+
+    The overlap path replaces the row-parallel projections' implicit
+    GSPMD all-reduce with explicit ``ppermute`` hops, so it needs ONE
+    concrete mesh axis that shards every row-parallel contraction dim
+    the same way.  Under SERVE_RULES that is "tensor"; rules that split
+    the contractions across different axes (or don't shard them) have
+    no ring and the caller keeps the unoverlapped path.
+    """
+    axes = set()
+    for name in contractions:
+        a = rules.assignment(name)
+        if a is None:
+            return None
+        axes.update((a,) if isinstance(a, str) else tuple(a))
+    if len(axes) != 1:
+        return None
+    return next(iter(axes))
+
+
 # Training: FSDP shards the non-TP weight dimension; batch spreads over
 # (data, fsdp); sequence axis shards the length dim for ring attention.
 TRAIN_RULES = PartitionRules({

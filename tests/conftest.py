@@ -63,5 +63,7 @@ def pytest_collection_modifyitems(config, items):
 @pytest.fixture(scope="session")
 def cpu_devices():
     devices = jax.devices()
-    assert len(devices) == 8
+    # >= 2 proves the forced virtual mesh is live; the default CI run
+    # gets 8, `make overlap` runs its TP=2 smoke under an explicit 4
+    assert len(devices) >= 2
     return devices

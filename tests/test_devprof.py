@@ -52,6 +52,27 @@ def test_classify_rule_table():
     assert classify("", "ALL-REDUCE.9") == "collective"
 
 
+def test_classify_fusion_names_embedding_collectives():
+    """Rule-order pin for the comm-overlap ring (docs/multichip.md):
+    XLA fuses the ring's ppermute hops with the neighbouring partial
+    matmuls/updates, emitting fusion names that embed BOTH a collective
+    and a matmul substring — the collective rule must stay first so
+    those slices land in comm_pct, never matmul/other."""
+    assert classify("", "fusion.all-reduce.3") == "collective"
+    assert classify("", "fusion.reduce-scatter.dot.1") == "collective"
+    assert classify("", "loop_all-gather_fusion.7") == "collective"
+    assert classify("", "fusion.collective-permute.2") == "collective"
+    assert classify("", "ppermute_dynamic-update-slice_fusion") \
+        == "collective"
+    # scoped form: the ring body's named_scope + a fused dot
+    assert classify("jit(step)/comm_overlap_ring/fusion",
+                    "all-reduce.dot.4") == "collective"
+    # a fusion with NO collective substring still classifies by its
+    # other needles — the pin is on ordering, not a catch-all
+    assert classify("", "fusion.dot.5") == "matmul"
+    assert classify("", "fusion.8") == "other"
+
+
 def test_phase_of():
     assert phase_of("jit(step)/kaito/decode/dot_general") == "decode"
     assert phase_of("a/kaito/prefill_packed/b") == "prefill_packed"
