@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: all native unit-test unit-test-fast unit-test-slow engine-test rag-test chaos kvq wquant kvpool lora structured obs devprof slo fleet autoscale spec qos asyncloop prefill overlap bench serve manager epp clean
+.PHONY: all native unit-test unit-test-fast unit-test-slow engine-test rag-test chaos kvq wquant kvpool lora structured obs devprof slo itl fleet autoscale spec qos asyncloop prefill overlap bench serve manager epp clean
 
 all: native
 
@@ -33,6 +33,8 @@ rag-test:
 # engine containment tests
 chaos:
 	$(PYTHON) -m pytest tests/test_failpoints.py -q
+	$(PYTHON) -m pytest tests/test_itl_slo.py -q -m "not slow" \
+	  -k "flight or fatal"
 
 # int8 KV-cache suite (docs/kv-cache.md): quantization round trips,
 # kernel dequant parity, P/D scale wire format, golden-pinned int8
@@ -81,8 +83,8 @@ structured:
 # legs run under unit-test / unit-test-slow)
 obs:
 	$(PYTHON) -m pytest tests/test_tracing.py tests/test_metrics_format.py \
-	  tests/test_slo.py tests/test_controllers.py tests/test_fleet.py \
-	  tests/test_prefill_pack.py tests/test_devprof.py \
+	  tests/test_slo.py tests/test_itl_slo.py tests/test_controllers.py \
+	  tests/test_fleet.py tests/test_prefill_pack.py tests/test_devprof.py \
 	  tests/test_comm_overlap.py -q -m "not slow"
 
 # device-time attribution suite (docs/observability.md "Device-time
@@ -107,6 +109,15 @@ overlap:
 # SLO watchdog suite alone (docs/observability.md "Control plane")
 slo:
 	$(PYTHON) -m pytest tests/test_slo.py -q
+
+# per-token ITL attribution + incident flight recorder
+# (docs/observability.md "Per-token ITL attribution"): watchdog itl_p99
+# burn/warn/page, engine emit-funnel stamps across decode modes, flight
+# bundle schema/LRU/endpoints, fleet folds + FlightRecorded Event,
+# annotation render/plan validation, live gated-on/off server legs —
+# fast tier; the decode-stall page-and-record e2e is the slow leg
+itl:
+	$(PYTHON) -m pytest tests/test_itl_slo.py -q -m "not slow"
 
 # fleet telemetry plane (docs/observability.md "Fleet telemetry"):
 # evaluator hysteresis, discovery, fold/gauge round-trips, concurrent

@@ -662,6 +662,7 @@ def _bench_serving_once(model_name: str, on_tpu: bool, quant: str,
                        quantization=quant, disable_rate_limit=True,
                        speculative_ngram=spec_ngram,
                        speculative_draft=spec_draft,
+                       itl_enabled=True,
                        max_queue_len=100000)
     eng = InferenceEngine(cfg)
     eng.start()
@@ -775,6 +776,7 @@ def _bench_serving_once(model_name: str, on_tpu: bool, quant: str,
         "dispatch_gap_ms": round(gap_ms, 3),
     }
     out.update(_devprof_pcts(eng))
+    out.update(_itl_metrics(eng))
     # every throughput row carries its roofline position (VERDICT r5
     # weak #1): how close this number is to the chip's compute and
     # HBM-bandwidth peaks
@@ -864,6 +866,22 @@ def _devprof_pcts(eng=None) -> dict:
         "comm_pct": round(float(last.get("comm_pct", 0.0)), 2),
         "overlap_pct": round(
             float(last.get("comm_compute_overlap_pct", 0.0)), 2),
+    }
+
+
+def _itl_metrics(eng=None) -> dict:
+    """True per-token ITL columns from the engine's retire-path stamps
+    (kaito:inter_token_latency_seconds).  Schema-stable: all three read
+    0.0 when the feature is off or no gaps were observed (the raw
+    ladder has no engine at all), same convention as
+    device_idle_pct/dispatch_gap_ms."""
+    h = getattr(eng, "itl_hist", None) if eng is not None else None
+    if h is None:
+        return {"itl_p50_ms": 0.0, "itl_p99_ms": 0.0, "itl_stall_count": 0}
+    return {
+        "itl_p50_ms": round(h.percentile(0.5) * 1e3, 3),
+        "itl_p99_ms": round(h.percentile(0.99) * 1e3, 3),
+        "itl_stall_count": int(eng.counters.get("itl_stalls_total", 0)),
     }
 
 
@@ -1099,6 +1117,7 @@ def phase_raw(args):
         "dispatch_gap_ms": round(gap_stats[1], 3),
     }
     result.update(_devprof_pcts())
+    result.update(_itl_metrics())
     result.update(_roofline_metrics(
         arch, best, batch, total_len, quant=args.quant,
         kv_dtype=args.kv_dtype, page_size=page_size))

@@ -128,7 +128,8 @@ def plan_workspace(store: Store, ws: Workspace):
     # acceptance (docs/multi-lora.md)
     from kaito_tpu.manifests.inference import (
         parse_adapters_annotation, parse_comm_overlap_annotation,
-        parse_devprof_annotation, parse_structured_output_annotation)
+        parse_devprof_annotation, parse_flight_annotation,
+        parse_itl_annotation, parse_structured_output_annotation)
     try:
         parse_adapters_annotation(ws.metadata.annotations.get(
             "kaito-tpu.io/adapters", ""))
@@ -160,6 +161,22 @@ def plan_workspace(store: Store, ws: Workspace):
     except ValueError as e:
         raise ValueError(
             f"invalid kaito-tpu.io/structured-output annotation: {e}")
+    # a malformed ITL gate or flight-recorder dir fails the plan the
+    # same way — the exact parses the renderer runs, so plan-time
+    # acceptance == render-time acceptance (docs/observability.md)
+    try:
+        parse_itl_annotation(ws.metadata.annotations.get(
+            "kaito-tpu.io/itl", ""))
+    except ValueError as e:
+        raise ValueError(f"invalid kaito-tpu.io/itl annotation: {e}")
+    try:
+        parse_flight_annotation(
+            ws.metadata.annotations.get("kaito-tpu.io/flight-dir", ""),
+            ws.metadata.annotations.get(
+                "kaito-tpu.io/flight-max-bundles", ""))
+    except ValueError as e:
+        raise ValueError(
+            f"invalid kaito-tpu.io/flight-dir annotation: {e}")
     # CP prefill auto-carve is evidence-gated (plan_parallelism
     # docstring: BENCH_r05 cp_speedup 0.68 < 1.0) — serve plans
     # only carve a sequence axis when the user opts in

@@ -184,8 +184,24 @@ class EngineConfig:
     # star).  Env vars KAITO_SLO_* override these at server start.
     slo_ttft_p50_ms: float = 200.0
     slo_ttft_p99_ms: float = 1000.0
+    slo_itl_p99_ms: float = 250.0
     slo_tokens_per_sec_per_chip: float = 2000.0
     slo_availability: float = 0.999
+    # true per-token inter-token latency (--itl / KAITO_ITL): stamp
+    # every retired token's wall time in the emit path and feed gaps
+    # into kaito:inter_token_latency_seconds + the watchdog's itl_p99
+    # SLI.  Off = no stamps, no families, byte-identical exposition.
+    itl_enabled: bool = False
+    # serving role this replica's SLO burn attributes to ("prefill" /
+    # "decode"; empty = "unified").  Set by the MRI role annotation via
+    # KAITO_INFERENCE_ROLE so disaggregated pools scale on the right SLO.
+    role: str = ""
+    # incident flight recorder (utils/flightrec.py): directory for
+    # bounded JSON bundles snapshotting every debug surface on an SLO
+    # page, an engine-fatal error, or SIGTERM with in-flight requests.
+    # Empty = off — no watcher thread, /debug/flight 403.
+    flight_dir: str = ""
+    flight_max_bundles: int = 16         # LRU by mtime beyond this
     # sampled device-time attribution (engine/devprof.py).  0 = off —
     # no sampler thread, no kaito:device_* families, /debug/device 403,
     # byte-identical exposition.  >0 captures a devprof_window_s

@@ -152,6 +152,10 @@ class Request:
     # any fetch failure silently falls back to a full local prefill.
     pool_blocks: list = field(default_factory=list)
     kv_prefix_tokens: int = 0
+    # per-token ITL (--itl): wall time of the last emitted token.  The
+    # stamp lives on the request, not the slot, so a gap that spans a
+    # preemption/re-admission still counts as one client-visible stall.
+    last_emit_time: Optional[float] = None
 
     @property
     def expired(self) -> bool:
@@ -666,6 +670,32 @@ class InferenceEngine:
             buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
                      0.5, 1.0, 2.5, 5.0))
         self._prefill_pack_note = 0
+
+        # true per-token inter-token latency (--itl / KAITO_ITL): every
+        # _emit() stamps wall time and observes the gap since the
+        # request's previous token — the single funnel covers the plain,
+        # speculative-replay and async-dispatch-replay retire paths.
+        # Off (default): itl_hist is None, _emit takes no extra work,
+        # and the /metrics exposition is byte-identical.
+        itl = cfg.itl_enabled if getattr(cfg, "itl_enabled", None) \
+            is not None else False
+        if not itl:
+            itl = os.environ.get("KAITO_ITL", "") in ("1", "true")
+        self.itl_enabled = bool(itl)
+        self.itl_hist = None
+        # server wires this to SLOWatchdog.observe_itl(gap, tenant)
+        self.itl_observer = None
+        self._itl_time = time.monotonic
+        if self.itl_enabled:
+            self._itl_stall_s = max(
+                1e-6, float(getattr(cfg, "slo_itl_p99_ms", 250.0)) * 1e-3)
+            self.counters["itl_stalls_total"] = 0
+            self.itl_hist = Histogram(
+                "kaito:inter_token_latency_seconds",
+                "True per-token inter-token latency (gap between "
+                "consecutive emitted tokens of one request)", None,
+                buckets=(0.002, 0.005, 0.01, 0.02, 0.04, 0.06, 0.08,
+                         0.1, 0.25, 0.5, 1.0, 2.5))
 
         self._decode_fn = self._build_decode_fn()
         self._prefill_fns: dict[int, object] = {}
@@ -4431,6 +4461,22 @@ class InferenceEngine:
         slot = self.slots[slot_idx]
         req = slot.request
         assert req is not None
+        if self.itl_hist is not None:
+            # the one stamp site all retire paths share: plain decode,
+            # speculative replay and async-dispatch replay each land in
+            # _emit per retired token (the PR-13 drain invariants make
+            # the replay point the correct client-visible instant)
+            now = self._itl_time()
+            last = req.last_emit_time
+            req.last_emit_time = now
+            if last is not None:
+                gap = now - last
+                self.itl_hist.observe(gap)
+                if gap > self._itl_stall_s:
+                    self.counters["itl_stalls_total"] += 1
+                obs = self.itl_observer
+                if obs is not None:
+                    obs(gap, req.tenant)
         req.output_tokens.append(token)
         gs = self._gram_slots[slot_idx]
         if gs is not None:

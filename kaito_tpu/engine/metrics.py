@@ -341,7 +341,11 @@ class EngineMetrics:
         self.ttft = Histogram(
             "kaito:time_to_first_token_seconds", "Time to first token", r)
         self.tpot = Histogram(
-            "kaito:time_per_output_token_seconds", "Inter-token latency", r,
+            "kaito:time_per_output_token_seconds",
+            "Per-request MEAN time per output token "
+            "((finish - first_token) / (n_out - 1)); decode stalls "
+            "average out — see kaito:inter_token_latency_seconds (--itl) "
+            "for true per-token gaps", r,
             buckets=(0.002, 0.005, 0.01, 0.02, 0.04, 0.06, 0.08, 0.1, 0.25,
                      0.5, 1.0))
         self.e2e_latency = Histogram(
@@ -366,6 +370,16 @@ class EngineMetrics:
                 h = getattr(engine, attr, None)
                 if h is not None:
                     r.register(h)
+
+            # true per-token ITL (--itl): itl_hist is None when the
+            # feature is off, so neither family exists and the
+            # exposition stays byte-identical
+            if getattr(engine, "itl_hist", None) is not None:
+                r.register(engine.itl_hist)
+                Gauge("kaito:itl_stalls_total",
+                      "Inter-token gaps exceeding the ITL SLO target "
+                      "(--slo-itl-p99-ms)", r,
+                      fn=lambda: engine.counters.get("itl_stalls_total", 0))
 
             def _slots_total():
                 slots = getattr(engine, "slots", None)

@@ -97,16 +97,60 @@ class ServerState:
         self.started = time.time()
         # north-star SLO watchdog: config targets, env override on top
         # (KAITO_SLO_* wins so operators can retune without a rollout)
+        itl_on = any(getattr(e, "itl_hist", None) is not None
+                     for e in self._engines())
         self.slo = SLOWatchdog(
             targets=SLOTargets.from_env(SLOTargets(
                 ttft_p50_s=cfg.slo_ttft_p50_ms / 1000.0,
                 ttft_p99_s=cfg.slo_ttft_p99_ms / 1000.0,
+                itl_p99_s=getattr(cfg, "slo_itl_p99_ms", 250.0) / 1000.0,
                 tokens_per_sec_per_chip=cfg.slo_tokens_per_sec_per_chip,
                 availability=cfg.slo_availability)),
             chips=engine_chip_count(engine),
-            per_tenant=self.qos is not None)
+            per_tenant=self.qos is not None,
+            itl_enabled=itl_on,
+            role=getattr(cfg, "role", "")
+            or os.environ.get("KAITO_INFERENCE_ROLE", ""))
         self.slo.register_metrics(self.metrics.registry)
+        # per-token ITL: the engine's retire-path stamp feeds the
+        # watchdog's itl windows directly (gap + tenant)
+        if itl_on:
+            for e in self._engines():
+                if getattr(e, "itl_hist", None) is not None:
+                    e.itl_observer = self.slo.observe_itl
+        # incident flight recorder (utils/flightrec.py): only with
+        # --flight-dir — no dir means no recorder, no watcher thread,
+        # no kaito:flight_bundles_total family, /debug/flight 403
+        self.flight = None
+        self.flight_watcher = None
+        if getattr(cfg, "flight_dir", ""):
+            from kaito_tpu.engine.metrics import Gauge
+            from kaito_tpu.utils.flightrec import (FlightRecorder,
+                                                   FlightWatcher,
+                                                   engine_flight_snapshot)
+
+            self.flight = FlightRecorder(
+                cfg.flight_dir,
+                collect=lambda: engine_flight_snapshot(
+                    self.engine, slo=self.slo, cfg=self.cfg),
+                max_bundles=getattr(cfg, "flight_max_bundles", 16))
+
+            def _fatal_total() -> int:
+                return sum(int(e.counters.get("engine_fatal_total", 0))
+                           for e in self._engines())
+
+            self.flight_watcher = FlightWatcher(
+                self.flight, slo_snapshot=self.slo.snapshot,
+                fatal_count=_fatal_total)
+            self.flight_watcher.start()
+            Gauge("kaito:flight_bundles_total",
+                  "Flight-recorder bundles written since process start",
+                  self.metrics.registry,
+                  fn=lambda: float(self.flight.bundles_total))
         self._profile_timer: Optional[threading.Timer] = None
+
+    def _engines(self):
+        return getattr(self.engine, "engines", None) or [self.engine]
 
 
 class OpenAIHandler(BaseHTTPRequestHandler):
@@ -283,6 +327,8 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             self._json(200, st.slo.snapshot())
         elif self.path.startswith("/debug/device"):
             self._debug_device()
+        elif self.path.startswith("/debug/flight"):
+            self._debug_flight_get()
         else:
             self._error(404, f"no route {self.path}")
 
@@ -339,6 +385,42 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         self._json(200, {"groups": [dict(p.snapshot(), group=gi)
                                     for gi, (_, p) in enumerate(profs)]})
 
+    def _debug_flight_get(self):
+        """Incident flight recorder (utils/flightrec.py): list bundles
+        at ``/debug/flight``, fetch one at ``/debug/flight/<name>``.
+        403 when ``--flight-dir`` is unset — the flight-off surface
+        stays byte-identical to the pre-flight server."""
+        rec = self.state.flight
+        if rec is None:
+            return self._error(
+                403, "flight recorder disabled (--flight-dir)")
+        rest = self.path[len("/debug/flight"):].strip("/")
+        if not rest:
+            return self._json(200, {"dir": rec.dir,
+                                    "bundles_total": rec.bundles_total,
+                                    "bundles": rec.list()})
+        raw = rec.read(rest)
+        if raw is None:
+            return self._error(404, f"no bundle {rest!r}")
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(raw)))
+        self.end_headers()
+        self.wfile.write(raw)
+
+    def _debug_flight_post(self):
+        """Manual trigger for live debugging: snapshot now."""
+        from kaito_tpu.utils.flightrec import TRIGGER_MANUAL
+
+        rec = self.state.flight
+        if rec is None:
+            return self._error(
+                403, "flight recorder disabled (--flight-dir)")
+        name = rec.record(TRIGGER_MANUAL, reason="POST /debug/flight")
+        if name is None:
+            return self._error(500, "flight bundle write failed")
+        self._json(200, {"bundle": name})
+
     def do_DELETE(self):
         self._intake_trace()
         if self.path.startswith("/pd/kv/"):
@@ -368,6 +450,8 @@ class OpenAIHandler(BaseHTTPRequestHandler):
             self._profile(start=True)
         elif self.path == "/stop_profile":
             self._profile(start=False)
+        elif self.path.startswith("/debug/flight"):
+            self._debug_flight_post()
         else:
             self._error(404, f"no route {self.path}")
 
@@ -1670,14 +1754,22 @@ class _PDServer(ThreadingHTTPServer):
             timer.cancel()
             st._profile_timer = None
 
+    def _stop_flight_watcher(self):
+        st = getattr(self, "state", None)
+        watcher = getattr(st, "flight_watcher", None) if st else None
+        if watcher is not None:
+            watcher.stop()
+
     def shutdown(self):
         self._pd_unregister()
         self._cancel_profile_timer()
+        self._stop_flight_watcher()
         super().shutdown()
 
     def server_close(self):
         self._pd_unregister()
         self._cancel_profile_timer()
+        self._stop_flight_watcher()
         super().server_close()
 
 
@@ -2003,6 +2095,34 @@ def main(argv=None):
                     default=float(os.environ.get(
                         "KAITO_DEVPROF_WINDOW_S", "0.25")),
                     help="capture length of each sampled devprof window")
+    ap.add_argument("--itl", action="store_true",
+                    default=os.environ.get("KAITO_ITL", "")
+                    in ("1", "true"),
+                    help="stamp every retired token and expose true "
+                         "per-token inter-token latency "
+                         "(kaito:inter_token_latency_seconds + the "
+                         "watchdog's itl_p99 SLI); off keeps the "
+                         "exposition and the decode path byte-identical")
+    ap.add_argument("--slo-itl-p99-ms", type=float,
+                    default=float(os.environ.get(
+                        "KAITO_SLO_ITL_P99_MS", "250")),
+                    help="ITL p99 SLO target (ms); gaps beyond it count "
+                         "as stalls and burn the itl_p99 budget")
+    ap.add_argument("--inference-role",
+                    default=os.environ.get("KAITO_INFERENCE_ROLE", ""),
+                    help="serving role this replica's SLO burn "
+                         "attributes to (prefill/decode; '' = unified) "
+                         "— set by the MRI role annotation")
+    ap.add_argument("--flight-dir",
+                    default=os.environ.get("KAITO_FLIGHT_DIR", ""),
+                    help="directory for incident flight-recorder "
+                         "bundles (written on SLO page, engine-fatal "
+                         "and SIGTERM-with-in-flight triggers; '' = "
+                         "off, /debug/flight answers 403)")
+    ap.add_argument("--flight-max-bundles", type=int,
+                    default=int(os.environ.get(
+                        "KAITO_FLIGHT_MAX_BUNDLES", "16")),
+                    help="bundles kept under --flight-dir (LRU by mtime)")
     args = ap.parse_args(argv)
 
     import jax
@@ -2063,6 +2183,11 @@ def main(argv=None):
         grammar_max_states=args.grammar_max_states,
         devprof_interval_s=args.devprof_interval_s,
         devprof_window_s=args.devprof_window_s,
+        itl_enabled=args.itl,
+        slo_itl_p99_ms=args.slo_itl_p99_ms,
+        role=args.inference_role,
+        flight_dir=args.flight_dir,
+        flight_max_bundles=args.flight_max_bundles,
     )
     if args.kaito_config_file:
         cfg = load_config_file(cfg, args.kaito_config_file)
@@ -2115,6 +2240,22 @@ def main(argv=None):
         stub.shutdown()
         stub.server_close()
     server = make_server(engine, cfg, host=args.host)
+    if cfg.flight_dir:
+        # third flight trigger: SIGTERM with requests still in flight
+        # (a drain that was going to lose work) snapshots the black box
+        # before the graceful shutdown path runs.  Raising
+        # KeyboardInterrupt re-enters the normal teardown below.
+        import signal
+
+        def _on_sigterm(signum, frame):
+            st = server.state
+            in_flight = engine.num_running + engine.num_waiting
+            if st.flight is not None and in_flight > 0:
+                st.flight.record(
+                    "sigterm", reason=f"{in_flight} request(s) in flight")
+            raise KeyboardInterrupt
+
+        signal.signal(signal.SIGTERM, _on_sigterm)
     logger.info("serving %s on %s:%d", cfg.model, args.host, cfg.port)
     try:
         server.serve_forever()

@@ -235,6 +235,64 @@ def parse_comm_overlap_annotation(text: str) -> Optional[bool]:
         f"(true/1/on/enabled or false/0/off/disabled), got {text!r}")
 
 
+def parse_itl_annotation(text: str) -> Optional[bool]:
+    """Parse the ``kaito-tpu.io/itl`` Workspace annotation
+    (docs/observability.md): the true per-token inter-token-latency
+    gate.  Empty input returns None — the server keeps its default
+    (off), so an absent annotation leaves the pod command and metrics
+    exposition byte-identical.  Accepts the usual boolean spellings.
+    Raises ValueError otherwise; the workspace controller calls this at
+    plan time so a bad annotation becomes a PlanFailed condition
+    instead of a crash-looping pod.  jax-free on purpose: the
+    controller imports it."""
+    text = (text or "").strip().lower()
+    if not text:
+        return None
+    if text in ("true", "1", "on", "enabled"):
+        return True
+    if text in ("false", "0", "off", "disabled"):
+        return False
+    raise ValueError(
+        f"itl annotation must be a boolean "
+        f"(true/1/on/enabled or false/0/off/disabled), got {text!r}")
+
+
+def parse_flight_annotation(dir_text: str,
+                            max_text: str = "") -> Optional[dict]:
+    """Parse the ``kaito-tpu.io/flight-dir`` (+ optional
+    ``kaito-tpu.io/flight-max-bundles``) Workspace annotations
+    (docs/observability.md): the incident flight recorder.  An empty
+    dir returns None — the server keeps its default (off), so an
+    absent annotation leaves the pod command byte-identical and
+    ``/debug/flight`` answers 403.  The dir must be an absolute path
+    (it names a pod-local volume mount); max-bundles must be a
+    positive integer.  Raises ValueError otherwise; the workspace
+    controller calls this at plan time so a bad annotation becomes a
+    PlanFailed condition instead of a crash-looping pod.  jax-free on
+    purpose: the controller imports it."""
+    dir_text = (dir_text or "").strip()
+    if not dir_text or dir_text.lower() in ("off", "false", "0"):
+        return None
+    if not dir_text.startswith("/"):
+        raise ValueError(
+            f"flight-dir annotation must be an absolute path "
+            f"(a pod-local volume mount), got {dir_text!r}")
+    out = {"dir": dir_text, "max_bundles": None}
+    max_text = (max_text or "").strip()
+    if max_text:
+        try:
+            n = int(max_text)
+        except ValueError:
+            raise ValueError(
+                f"flight-max-bundles annotation must be a positive "
+                f"integer, got {max_text!r}") from None
+        if n <= 0:
+            raise ValueError(
+                "flight-max-bundles annotation must be >= 1")
+        out["max_bundles"] = n
+    return out
+
+
 def coordinator_address(workspace_name: str, namespace: str) -> str:
     """Pod-0 DNS via the headless service — same convention the
     reference uses for the Ray leader (``pkg/utils/common.go:229``),
@@ -361,6 +419,23 @@ def build_engine_command(
         ws.metadata.annotations.get("kaito-tpu.io/comm-overlap", ""))
     if overlap:
         args += ["--comm-overlap"]
+    # true per-token ITL (docs/observability.md): off is the server
+    # default, so only an explicit opt-in renders — absent (or an
+    # explicit off) keeps the pod command and exposition byte-identical
+    itl = parse_itl_annotation(
+        ws.metadata.annotations.get("kaito-tpu.io/itl", ""))
+    if itl:
+        args += ["--itl"]
+    # incident flight recorder (docs/observability.md): only an
+    # explicit dir renders — absent keeps the pod command
+    # byte-identical and /debug/flight answers 403
+    flight = parse_flight_annotation(
+        ws.metadata.annotations.get("kaito-tpu.io/flight-dir", ""),
+        ws.metadata.annotations.get("kaito-tpu.io/flight-max-bundles", ""))
+    if flight is not None:
+        args += ["--flight-dir", flight["dir"]]
+        if flight["max_bundles"] is not None:
+            args += ["--flight-max-bundles", str(flight["max_bundles"])]
     if config_file:
         args += ["--kaito-config-file", config_file]
     if adapters_dir:
@@ -386,7 +461,10 @@ def engine_env(ws: Workspace, md: ModelMetadata, plan: ParallelPlan) -> list[dic
     if role:
         # P/D roles enable the KV side-channel, restricted to in-cluster
         # peers of this MRI (reference: NIXL env + routing sidecar,
-        # preset_inferences.go:909-985)
+        # preset_inferences.go:909-985).  The role also keys the SLO
+        # watchdog's burn attribution (ROADMAP item 1): prefill pools
+        # page on TTFT burn, decode pools on ITL burn.
+        env.append({"name": "KAITO_INFERENCE_ROLE", "value": role})
         env.append({"name": "KAITO_PD_ENABLED", "value": "true"})
         env.append({"name": "KAITO_PD_ALLOWLIST",
                     "value": f"http://{ws.metadata.labels.get('kaito-tpu.io/multirole-inference', ws.metadata.name)}-"})

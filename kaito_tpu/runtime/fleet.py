@@ -70,6 +70,7 @@ SIGNAL_CODE = {SIGNAL_IDLE: 0, SIGNAL_NOMINAL: 1,
 COND_SCALING_SIGNAL = "ScalingSignal"
 EVENT_PRESSURE_DETECTED = "FleetPressureDetected"
 EVENT_PRESSURE_RESOLVED = "FleetPressureResolved"
+EVENT_FLIGHT_RECORDED = "FlightRecorded"
 
 # engine series folded per replica: family -> (sample key, fold across
 # labelled series of ONE payload).  Gauges; counters are listed below.
@@ -92,6 +93,10 @@ _ENGINE_GAUGES = {
     "kaito:device_comm_compute_overlap_pct": ("device_overlap_pct",
                                               "mean"),
     "kaito:device_idle_pct": ("device_idle_pct", "mean"),
+    # incident flight recorder (utils/flightrec.py): bundles written
+    # since process start, present only with --flight-dir — the summed
+    # fold feeds the controller's FlightRecorded Event
+    "kaito:flight_bundles_total": ("flight_bundles", "sum"),
 }
 # cumulative counters -> per-replica delta rates at fold time
 _ENGINE_COUNTERS = {
@@ -372,6 +377,10 @@ class _CRSeries:
         self.transitions = 0
         self.last_decision: Optional[SignalDecision] = None
         self.replicas_desired = 0
+        # flight-recorder Event dedupe: folded bundle count at the last
+        # FlightRecorded Event (None = no baseline yet — the first
+        # observation must not read pre-existing bundles as an incident)
+        self.flight_bundles_seen: Optional[float] = None
         # per-CR hint overrides from spec.autoscale (scale_to_zero,
         # max_replicas); None = global policy (one config source for
         # recommended_replicas hints AND actuation)
@@ -615,6 +624,17 @@ class FleetTelemetry:
                         snap = json.loads(slo_body)
                         values["burn_max"] = float(
                             snap.get("burn_max", 0.0))
+                        # per-role attribution (ROADMAP item 1): the
+                        # replica's role keys a dynamic burn field so
+                        # the P/D split can act on the right SLO per
+                        # pool; the ITL SLI rides along when enabled
+                        role = str(snap.get("role", "") or "unified")
+                        values[f"role_burn:{role}"] = values["burn_max"]
+                        itl = (snap.get("burn_rates") or {}).get(
+                            "itl_p99")
+                        if itl is not None:
+                            values["itl_burn_max"] = float(
+                                itl.get("fast", 0.0))
                 except (ValueError, ConnectionError, OSError):
                     pass                  # burn is optional per scrape
         except (ConnectionError, OSError, ValueError) as e:
@@ -803,6 +823,13 @@ class FleetTelemetry:
             "shed_rate": rate("shed_rate"),
             "tokens_rate": rate("gen_tokens_rate"),
             "burn_max": max(vals("burn_max"), default=0.0),
+            # per-token ITL SLI (replicas running with --itl): worst
+            # fast-window itl_p99 burn across the fleet
+            "itl_burn_max": max(vals("itl_burn_max"), default=0.0),
+            # incident flight recorder: bundles written across replicas
+            # (apply_signals turns an increase into a FlightRecorded
+            # Event on the owning CR)
+            "flight_bundles": fold("flight_bundles", "sum"),
             "prefix_hit_rate": hit / (hit + miss) if hit + miss > 0 else 0.0,
             "spec_accept_rate": acc / prop if prop > 0 else 0.0,
             # host KV offload tier, cluster-wide: capacity (entries /
@@ -862,6 +889,14 @@ class FleetTelemetry:
             for rk, rv in s.rates.items():
                 if rk.startswith("tenant_") and ":" in rk:
                     agg[rk] = agg.get(rk, 0.0) + rv
+        # per-role SLO burn (ROADMAP item 1): worst burn per serving
+        # role across replicas, keyed "role_burn:<role>" — the P/D
+        # autoscaler scales prefill pools on TTFT burn and decode pools
+        # on ITL burn without mixing the two
+        for s in replicas:
+            for rk, rv in s.values.items():
+                if rk.startswith("role_burn:"):
+                    agg[rk] = max(agg.get(rk, 0.0), rv)
         return agg
 
     # -- evaluation + condition/event surfacing ------------------------
@@ -983,6 +1018,23 @@ class FleetTelemetry:
                 record_event(self.store, obj, "Normal",
                              EVENT_PRESSURE_RESOLVED,
                              f"fleet back to {decision.state}")
+            # incident flight recorder: surface a FlightRecorded Event
+            # the moment any replica's bundle count advances past the
+            # remembered baseline (first observation only arms it, so
+            # pre-existing bundles don't read as a fresh incident;
+            # restarts lower the sum and just re-baseline)
+            fb = decision.observed.get("flight_bundles", 0.0)
+            with self._lock:
+                cr = self._crs.get(key)
+                seen = cr.flight_bundles_seen if cr is not None else None
+                if cr is not None:
+                    cr.flight_bundles_seen = fb
+            if seen is not None and fb > seen:
+                record_event(
+                    self.store, obj, "Warning", EVENT_FLIGHT_RECORDED,
+                    f"flight-recorder bundle(s) written "
+                    f"({int(seen)} -> {int(fb)}): fetch via "
+                    f"GET /debug/flight on the replicas")
 
     # -- export: gauges + /debug/fleet ---------------------------------
 
@@ -1047,6 +1099,29 @@ class FleetTelemetry:
         Gauge("kaito:fleet_slo_burn_max",
               "Worst replica fast-window SLO burn per CR", r,
               labels=("kind", "name"), fn=family("burn_max"))
+        Gauge("kaito:fleet_slo_itl_burn_max",
+              "Worst replica fast-window ITL p99 burn per CR "
+              "(replicas running with --itl)", r,
+              labels=("kind", "name"), fn=family("itl_burn_max"))
+
+        def _role_burns():
+            out = {}
+            with self._lock:
+                for k, agg in self._last_agg.items():
+                    for field_, v in agg.items():
+                        if field_.startswith("role_burn:"):
+                            role = field_.split(":", 1)[1]
+                            out[(k[0], k[2], role)] = v
+            return out
+
+        Gauge("kaito:fleet_slo_role_burn_max",
+              "Worst replica fast-window SLO burn per CR and serving "
+              "role (prefill/decode/unified)", r,
+              labels=("kind", "name", "role"), fn=_role_burns)
+        Gauge("kaito:fleet_flight_bundles",
+              "Flight-recorder bundles written across reporting "
+              "replicas", r,
+              labels=("kind", "name"), fn=family("flight_bundles"))
         Gauge("kaito:fleet_host_kv_entries",
               "Host KV offload entries summed over the fleet", r,
               labels=("kind", "name"), fn=family("host_kv_entries"))

@@ -55,11 +55,13 @@ class SLOTargets:
 
     ttft_p50_s: float = 0.200            # p50 TTFT < 200 ms
     ttft_p99_s: float = 1.0              # tail TTFT
+    itl_p99_s: float = 0.250             # tail inter-token gap (per token)
     tokens_per_sec_per_chip: float = 2000.0
     availability: float = 0.999          # success / (success+fail+shed)
-    # fraction of requests that must meet each TTFT bound
+    # fraction of requests/tokens that must meet each latency bound
     ttft_p50_fraction: float = 0.50
     ttft_p99_fraction: float = 0.99
+    itl_p99_fraction: float = 0.99
 
     @classmethod
     def from_env(cls, base: "Optional[SLOTargets]" = None) -> "SLOTargets":
@@ -75,17 +77,20 @@ class SLOTargets:
         return cls(
             ttft_p50_s=f("KAITO_SLO_TTFT_P50_MS", t.ttft_p50_s, 1e-3),
             ttft_p99_s=f("KAITO_SLO_TTFT_P99_MS", t.ttft_p99_s, 1e-3),
+            itl_p99_s=f("KAITO_SLO_ITL_P99_MS", t.itl_p99_s, 1e-3),
             tokens_per_sec_per_chip=f("KAITO_SLO_TOKENS_PER_SEC_PER_CHIP",
                                       t.tokens_per_sec_per_chip),
             availability=f("KAITO_SLO_AVAILABILITY", t.availability),
             ttft_p50_fraction=t.ttft_p50_fraction,
             ttft_p99_fraction=t.ttft_p99_fraction,
+            itl_p99_fraction=t.itl_p99_fraction,
         )
 
     def to_dict(self) -> dict:
         return {
             "ttft_p50_ms": round(self.ttft_p50_s * 1000, 3),
             "ttft_p99_ms": round(self.ttft_p99_s * 1000, 3),
+            "itl_p99_ms": round(self.itl_p99_s * 1000, 3),
             "tokens_per_sec_per_chip": self.tokens_per_sec_per_chip,
             "availability": self.availability,
         }
@@ -163,7 +168,8 @@ class SLOWatchdog:
                  windows: tuple[float, float] = (WINDOW_FAST_S,
                                                 WINDOW_SLOW_S),
                  time_fn: Callable[[], float] = time.monotonic,
-                 per_tenant: bool = False):
+                 per_tenant: bool = False, itl_enabled: bool = False,
+                 role: str = ""):
         self.targets = targets or SLOTargets()
         self.chips = max(1, int(chips))
         self.window_fast_s, self.window_slow_s = windows
@@ -175,11 +181,21 @@ class SLOWatchdog:
         self.success = WindowSeries(slow, time_fn)
         self.failure = WindowSeries(slow, time_fn)
         self.shed = WindowSeries(slow, time_fn)
+        # per-token inter-token gaps (--itl): the itl_p99 SLI and its
+        # gauges only exist when the engine-side stamping is on, so the
+        # ITL-off exposition stays byte-identical
+        self.itl_enabled = bool(itl_enabled)
+        self.itl = WindowSeries(slow, time_fn)
+        # P/D role attribution (ROADMAP item 1): "prefill" / "decode" /
+        # "unified"; a non-empty role adds the kaito:slo_role info gauge
+        self._role_set = bool(role)
+        self.role = role or "unified"
         # per-tenant QoS slices (docs/qos.md): only with a QoS config —
         # the gauges they feed must not exist in the QoS-off exposition
         self.per_tenant = per_tenant
         self._tenant_ttft: dict[str, WindowSeries] = {}
         self._tenant_shed: dict[str, WindowSeries] = {}
+        self._tenant_itl: dict[str, WindowSeries] = {}
 
     # -- feeds ---------------------------------------------------------
 
@@ -194,6 +210,12 @@ class SLOWatchdog:
         self.ttft.add(seconds)
         if self.per_tenant and tenant:
             self._tenant_series(self._tenant_ttft, tenant).add(seconds)
+
+    def observe_itl(self, seconds: float, tenant: str = "") -> None:
+        """One inter-token gap (the engine's retire-path stamp)."""
+        self.itl.add(seconds)
+        if self.per_tenant and tenant:
+            self._tenant_series(self._tenant_itl, tenant).add(seconds)
 
     def note_tokens(self, n: int) -> None:
         if n > 0:
@@ -224,7 +246,8 @@ class SLOWatchdog:
         degradation ladder's observable: a guaranteed tenant's p50
         holds while best-effort sheds climb."""
         out: dict = {}
-        for t in sorted(set(self._tenant_ttft) | set(self._tenant_shed)):
+        for t in sorted(set(self._tenant_ttft) | set(self._tenant_shed)
+                        | set(self._tenant_itl)):
             ttfts = (self._tenant_ttft[t].values(self.window_fast_s)
                      if t in self._tenant_ttft else [])
             shed = (self._tenant_shed[t].total(self.window_fast_s)
@@ -232,6 +255,11 @@ class SLOWatchdog:
             out[t] = {"ttft_p50_s": round(_percentile(ttfts, 0.50), 6),
                       "ttft_samples": len(ttfts),
                       "shed": int(shed)}
+            if self.itl_enabled:
+                itls = (self._tenant_itl[t].values(self.window_fast_s)
+                        if t in self._tenant_itl else [])
+                out[t]["itl_p99_s"] = round(_percentile(itls, 0.99), 6)
+                out[t]["itl_samples"] = len(itls)
         return out
 
     # -- evaluation ----------------------------------------------------
@@ -253,7 +281,7 @@ class SLOWatchdog:
         total = ok + fail + shed
         toks = self.tokens.total(window_s)
         tok_s_chip = toks / self._window_elapsed(window_s) / self.chips
-        return {
+        out = {
             "ttft_p50_s": round(_percentile(ttfts, 0.50), 6),
             "ttft_p99_s": round(_percentile(ttfts, 0.99), 6),
             "ttft_samples": n,
@@ -271,15 +299,26 @@ class SLOWatchdog:
             "throughput_burning": bool(
                 toks > 0 and tok_s_chip < t.tokens_per_sec_per_chip),
         }
+        if self.itl_enabled:
+            itls = self.itl.values(window_s)
+            bad_itl = sum(1 for v in itls if v > t.itl_p99_s)
+            out["itl_p50_s"] = round(_percentile(itls, 0.50), 6)
+            out["itl_p99_s"] = round(_percentile(itls, 0.99), 6)
+            out["itl_samples"] = len(itls)
+            out["burn"]["itl_p99"] = _ratio_burn(
+                bad_itl, len(itls), 1 - t.itl_p99_fraction)
+        return out
 
     def snapshot(self) -> dict:
         """The ``/debug/slo`` payload (and the probe's verdict)."""
         fast = self._eval_window(self.window_fast_s)
         slow = self._eval_window(self.window_slow_s)
+        slis = ("ttft_p50", "ttft_p99", "availability") + \
+            (("itl_p99",) if self.itl_enabled else ())
         burn_rates = {
             sli: {"fast": round(fast["burn"][sli], 4),
                   "slow": round(slow["burn"][sli], 4)}
-            for sli in ("ttft_p50", "ttft_p99", "availability")
+            for sli in slis
         }
         alerts = {
             sli: _alert_state(b["fast"], b["slow"])
@@ -298,6 +337,7 @@ class SLOWatchdog:
         fast.pop("throughput_burning"), slow.pop("throughput_burning")
         out = {
             "burn_max": round(burn_max, 4),
+            "role": self.role,
             "targets": self.targets.to_dict(),
             "windows": {"fast_s": self.window_fast_s,
                         "slow_s": self.window_slow_s},
@@ -351,6 +391,23 @@ class SLOWatchdog:
         Gauge("kaito:slo_healthy",
               "1 while no SLI is in the page state", registry,
               fn=lambda: 1.0 if self.snapshot()["healthy"] else 0.0)
+        if self.itl_enabled:
+            # ITL-only families — the itl_p99 entry in burn_rates /
+            # alerts above is likewise gated, so the ITL-off exposition
+            # stays byte-identical
+            Gauge("kaito:slo_itl_p50_seconds",
+                  "Rolling fast-window inter-token latency p50", registry,
+                  fn=lambda: self._eval_window(
+                      self.window_fast_s)["itl_p50_s"])
+            Gauge("kaito:slo_itl_p99_seconds",
+                  "Rolling fast-window inter-token latency p99", registry,
+                  fn=lambda: self._eval_window(
+                      self.window_fast_s)["itl_p99_s"])
+        if self._role_set:
+            Gauge("kaito:slo_role",
+                  "Info gauge: the serving role this replica's SLO burn "
+                  "attributes to", registry, labels=("role",),
+                  fn=lambda: {(self.role,): 1.0})
         if self.per_tenant:
             # QoS-only families — registering them unconditionally
             # would add HELP/TYPE lines to the QoS-off exposition
@@ -368,6 +425,15 @@ class SLOWatchdog:
             Gauge("kaito:slo_tenant_shed",
                   "Fast-window requests shed per tenant", registry,
                   labels=("tenant",), fn=_tenant_sheds)
+            if self.itl_enabled:
+                def _tenant_itls() -> dict:
+                    return {(t,): s.get("itl_p99_s", 0.0)
+                            for t, s in self.tenant_snapshot().items()}
+
+                Gauge("kaito:slo_tenant_itl_p99_seconds",
+                      "Rolling fast-window inter-token latency p99 per "
+                      "tenant", registry, labels=("tenant",),
+                      fn=_tenant_itls)
 
 
 def condition_from_verdict(verdict: dict) -> tuple[str, str, str]:
