@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: all native unit-test unit-test-fast unit-test-slow engine-test rag-test chaos kvq wquant kvpool lora structured obs devprof slo itl fleet autoscale spec qos asyncloop prefill overlap bench serve manager epp clean
+.PHONY: all native unit-test unit-test-fast unit-test-slow engine-test rag-test chaos kvq wquant kvpool kvtier lora structured obs devprof slo itl fleet autoscale spec qos asyncloop prefill overlap bench serve manager epp clean
 
 all: native
 
@@ -59,6 +59,14 @@ wquant:
 kvpool:
 	$(PYTHON) -m pytest tests/test_kv_pool.py -q -m "not slow"
 
+# KV pool tier-3 suite (docs/kv-pool.md "Tier 3: SSD"): disk slab
+# store units (spill/scan/prune/corruption), break-even veto, capped
+# advert + EPP merge, session pin routing, annotation plumbing, and
+# the multi-turn replay-from-SSD + corrupt-slab-recompute live legs —
+# fast tier; the session-pin TTFT e2e is the slow leg
+kvtier:
+	$(PYTHON) -m pytest tests/test_kv_tier.py -q -m "not slow"
+
 # multi-LoRA suite (docs/multi-lora.md): adapter-cache refusals +
 # LRU/pinning/host tier, heterogeneous-batch greedy equivalence,
 # zero-retrace pin, int8-KV x spec compose, hash-chain isolation,
@@ -85,7 +93,7 @@ obs:
 	$(PYTHON) -m pytest tests/test_tracing.py tests/test_metrics_format.py \
 	  tests/test_slo.py tests/test_itl_slo.py tests/test_controllers.py \
 	  tests/test_fleet.py tests/test_prefill_pack.py tests/test_devprof.py \
-	  tests/test_comm_overlap.py -q -m "not slow"
+	  tests/test_comm_overlap.py tests/test_kv_tier.py -q -m "not slow"
 
 # device-time attribution suite (docs/observability.md "Device-time
 # attribution"): bucket classifier, XPlane wire + chrome-trace parsers,

@@ -449,6 +449,25 @@ def orchestrate(args):
             merged.setdefault("errors", []).append(res["error"])
         save_partial()
 
+    # --- phase: multi-turn conversation replay over the KV tiers
+    # (docs/kv-pool.md "Tier 3: SSD") — schema-stable: the keys exist
+    # at 0.0 even when the leg is skipped or fails, so result diffing
+    # across runs never keys on a missing column ---
+    conv_keys = ("conversation_turn1_ttft_s", "conversation_turn2_ttft_s",
+                 "conversation_turn3_ttft_s", "conversation_turn3_vs_turn1",
+                 "conversation_host_hits", "conversation_disk_hits",
+                 "conversation_import_tokens",
+                 "conversation_disk_read_bytes_s")
+    if not args.skip_conversation_bench and remaining() > 90:
+        res = run_phase("conversation", passthru, min(remaining(), 400.0))
+        if "error" not in res:
+            merged.update(res)
+        else:
+            merged.setdefault("errors", []).append(res["error"])
+    for k in conv_keys:
+        merged.setdefault(k, 0.0)
+    save_partial()
+
     # --- phase: multi-LoRA hot-load + adapter decode (docs/multi-lora.md) ---
     if not args.skip_lora_bench and remaining() > 90:
         extra = ["--force-cpu"] if args.force_cpu else []
@@ -1698,6 +1717,110 @@ def phase_kvpool(args):
         b_eng.stop()
 
 
+def phase_conversation(args):
+    """Multi-turn conversation replay (docs/kv-pool.md "Tier 3: SSD"):
+    one live engine with the disk tier on replays a conversation —
+    turn 1 cold-prefills the history, turn 2 (history + new message)
+    imports the turn-1 prefix from the HOST pool store, then the host
+    store is squeezed so the conversation demotes to SSD and turn 3
+    imports the same prefix from DISK.  Reports per-turn TTFT and the
+    per-tier hit split: the whole point of the tier is that turn-N
+    TTFT stays below turn-1 even after the conversation leaves RAM."""
+    jax = _init_jax(force_cpu=args.force_cpu)
+    import shutil
+    import tempfile
+    import urllib.request
+
+    from kaito_tpu.engine.config import EngineConfig
+    from kaito_tpu.engine.engine import InferenceEngine
+    from kaito_tpu.engine.server import make_server
+
+    on_tpu = jax.devices()[0].platform not in ("cpu",)
+    model_name = args.model or "tiny-llama-test"
+    disk_dir = tempfile.mkdtemp(prefix="kaito-kv-bench-")
+    cfg = EngineConfig(
+        model=model_name, max_model_len=1024, page_size=16, max_num_seqs=2,
+        dtype="bfloat16" if on_tpu else "float32",
+        kv_dtype=args.kv_dtype or ("bfloat16" if on_tpu else "float32"),
+        prefill_buckets=(128, 512, 1024), seed=0, kv_pool_enabled=True,
+        kv_pool_disk_bytes=1 << 30, kv_pool_disk_dir=disk_dir)
+    eng = InferenceEngine(cfg)
+    eng.start()
+    srv = make_server(eng, cfg, host="127.0.0.1", port=0)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{srv.server_address[1]}"
+
+    def post(body):
+        req = urllib.request.Request(
+            url + "/v1/completions", data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        return json.loads(urllib.request.urlopen(req, timeout=120).read())
+
+    out: dict = {"conversation_model": model_name}
+    try:
+        # every unit is EXACTLY 30 chars (byte-level tokenizer keeps
+        # turn lengths in the same compile bucket across replays)
+        history = "conversation history filler x " * 28
+        suffix = "then one new user question ab "
+        compile_hist = "warmup compile bucket filler x" * 28
+        # pre-compile the long-prefill bucket, then the import +
+        # short-remainder programs via a sacrificial conversation
+        post({"prompt": compile_hist, "max_tokens": 1, "temperature": 0.0})
+        post({"prompt": compile_hist + suffix, "max_tokens": 1,
+              "temperature": 0.0})
+        # turn 1: cold full prefill of the history
+        t0 = time.monotonic()
+        post({"prompt": history, "max_tokens": 1, "temperature": 0.0})
+        turn1_s = time.monotonic() - t0
+        # turn 2: history + new message -> host-tier import
+        t0 = time.monotonic()
+        post({"prompt": history + suffix, "max_tokens": 1,
+              "temperature": 0.0})
+        turn2_s = time.monotonic() - t0
+        # squeeze the host store to ~1.2 average entries: the budget
+        # still ADMITS the equal-length evictor (put() refuses an
+        # entry bigger than the whole budget without evicting) but its
+        # publish forces every resident entry out, and the spill
+        # worker demotes the conversation to SSD
+        evictor = "unrelated talk pushing it out " * 28
+        resident = max(1, len(eng.kv_pool))
+        eng.kv_pool.max_bytes = max(
+            1, int(eng.kv_pool.used_bytes / resident * 1.2))
+        post({"prompt": evictor, "max_tokens": 1, "temperature": 0.0})
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if eng.kv_tier.spills_total >= resident:
+                break
+            time.sleep(0.05)
+        # turn 3: the replayed conversation now imports from DISK
+        t0 = time.monotonic()
+        post({"prompt": history + suffix, "max_tokens": 1,
+              "temperature": 0.0})
+        turn3_s = time.monotonic() - t0
+        snap = eng.pd_costs.snapshot()
+        out.update({
+            "conversation_turn1_ttft_s": turn1_s,
+            "conversation_turn2_ttft_s": turn2_s,
+            "conversation_turn3_ttft_s": turn3_s,
+            "conversation_turn3_vs_turn1": turn3_s / max(turn1_s, 1e-9),
+            "conversation_host_hits":
+                float(eng.counters["kv_tier_host_hits_total"]),
+            "conversation_disk_hits":
+                float(eng.counters["kv_tier_disk_hits_total"]),
+            "conversation_import_tokens":
+                float(eng.counters["kv_tier_import_tokens_total"]),
+            "conversation_disk_read_bytes_s":
+                float(snap.get("disk_bytes_s") or 0.0),
+        })
+        if eng.counters["kv_tier_disk_hits_total"] < 1:
+            out["error"] = "conversation: turn 3 never hit the disk tier"
+        print(json.dumps(out), flush=True)
+    finally:
+        srv.shutdown()
+        eng.stop()
+        shutil.rmtree(disk_dir, ignore_errors=True)
+
+
 def phase_lora(args):
     """Multi-LoRA serving (docs/multi-lora.md): hot-load latency into
     the HBM slot table, the zero-retrace pin across the load, base vs
@@ -1881,7 +2004,7 @@ def main():
     ap.add_argument("--phase", default="",
                     choices=["", "watch", "probe", "raw", "serve",
                              "int8_8b", "pd", "cp", "multichip", "prefix",
-                             "prefill_burst", "kvpool",
+                             "prefill_burst", "kvpool", "conversation",
                              "lora", "structured", "wquant_quality"])
     ap.add_argument("--cp-tokens", type=int, default=8192)
     ap.add_argument("--cp-attn-only", action="store_true",
@@ -1925,6 +2048,10 @@ def main():
     ap.add_argument("--skip-server-bench", action="store_true")
     ap.add_argument("--skip-int8-8b", action="store_true")
     ap.add_argument("--skip-pd-bench", action="store_true")
+    ap.add_argument("--skip-conversation-bench", action="store_true",
+                    help="skip the multi-turn conversation replay leg "
+                         "over the KV tiers (docs/kv-pool.md); its "
+                         "result keys stay present at 0.0")
     ap.add_argument("--skip-lora-bench", action="store_true",
                     help="skip the multi-LoRA hot-load/adapter-decode "
                          "legs (docs/multi-lora.md)")
@@ -1954,6 +2081,8 @@ def main():
         phase_pd(args)
     elif args.phase == "kvpool":
         phase_kvpool(args)
+    elif args.phase == "conversation":
+        phase_conversation(args)
     elif args.phase == "lora":
         phase_lora(args)
     elif args.phase == "structured":

@@ -129,7 +129,8 @@ def plan_workspace(store: Store, ws: Workspace):
     from kaito_tpu.manifests.inference import (
         parse_adapters_annotation, parse_comm_overlap_annotation,
         parse_devprof_annotation, parse_flight_annotation,
-        parse_itl_annotation, parse_structured_output_annotation)
+        parse_itl_annotation, parse_kv_pool_disk_annotation,
+        parse_structured_output_annotation)
     try:
         parse_adapters_annotation(ws.metadata.annotations.get(
             "kaito-tpu.io/adapters", ""))
@@ -177,6 +178,17 @@ def plan_workspace(store: Store, ws: Workspace):
     except ValueError as e:
         raise ValueError(
             f"invalid kaito-tpu.io/flight-dir annotation: {e}")
+    # a malformed SSD-tier budget (or one named without the pool)
+    # fails the plan the same way — the exact parse the renderer runs,
+    # so plan-time acceptance == render-time acceptance
+    # (docs/kv-pool.md "Tier 3: SSD")
+    try:
+        parse_kv_pool_disk_annotation(
+            ws.metadata.annotations.get("kaito-tpu.io/kv-pool-disk", ""),
+            ws.metadata.annotations.get("kaito-tpu.io/kv-pool", ""))
+    except ValueError as e:
+        raise ValueError(
+            f"invalid kaito-tpu.io/kv-pool-disk annotation: {e}")
     # CP prefill auto-carve is evidence-gated (plan_parallelism
     # docstring: BENCH_r05 cp_speedup 0.68 < 1.0) — serve plans
     # only carve a sequence axis when the user opts in

@@ -149,6 +149,17 @@ class EngineConfig:
     kv_pool_bytes: int = 1 << 30         # host bytes for the prefix store
     kv_pool_min_tokens: int = 0          # min prefix tokens to publish
     # (0 = one KV page, i.e. page_size tokens)
+    # tier-3 SSD spill under the pool (docs/kv-pool.md "Tier 3: SSD"):
+    # entries evicted from the host LRU demote to a bounded slab
+    # directory instead of vanishing, and pool misses probe it before
+    # remote peers and before recompute.  0 = no disk tier (no spill
+    # thread, no kv_tier metric families — byte-identical off).
+    kv_pool_disk_bytes: int = 0
+    kv_pool_disk_dir: str = ""           # "" = <tempdir>/kaito-kv-tier
+    # cap /debug/kv_pool adverts to the freshest N entries per scrape
+    # (0 = unlimited); the EPP treats a capped advert as authoritative
+    # only for the rows it lists
+    kv_pool_advert_max: int = 0
     # grammar-constrained decoding (docs/structured-output.md):
     # response_format={json_schema|json_object|regex} and forced tool
     # calls compile into token-level masks applied on device.  The

@@ -152,6 +152,10 @@ class Request:
     # any fetch failure silently falls back to a full local prefill.
     pool_blocks: list = field(default_factory=list)
     kv_prefix_tokens: int = 0
+    # conversation identity (X-Kaito-Session): opaque client-chosen id
+    # that keys session→holder routing in the EPP; "" for one-shot
+    # requests keeps every pre-session code path byte-identical.
+    session: str = ""
     # per-token ITL (--itl): wall time of the last emitted token.  The
     # stamp lives on the request, not the slot, so a gap that spans a
     # preemption/re-admission still counts as one client-visible stall.
@@ -555,6 +559,32 @@ class InferenceEngine:
             self.kv_pool = PrefixPageStore(cfg.kv_pool_bytes)
             logger.info("cluster KV pool store: %.2f GiB",
                         cfg.kv_pool_bytes / 2**30)
+        # tier-3 SSD spill (docs/kv-pool.md "Tier 3: SSD"): host-LRU
+        # victims demote to a bounded slab directory via an async spill
+        # worker (serialization may block on a D2H drain — never on the
+        # step loop), and pool misses probe it before remote peers.
+        # None when off — every tier code path AND the kv_tier metric
+        # families gate on it, keeping disk-off byte-identical.
+        self.kv_tier = None
+        self._spill_q: Optional[queue.Queue] = None
+        self._spill_thread: Optional[threading.Thread] = None
+        if (self.kv_pool is not None
+                and getattr(cfg, "kv_pool_disk_bytes", 0) > 0):
+            import tempfile
+
+            from kaito_tpu.engine.kv_pool import DiskPageStore
+
+            root = getattr(cfg, "kv_pool_disk_dir", "") or os.path.join(
+                tempfile.gettempdir(), "kaito-kv-tier")
+            self.kv_tier = DiskPageStore(root, cfg.kv_pool_disk_bytes)
+            self._spill_q = queue.Queue(maxsize=256)
+            self.kv_pool.on_evict = self._enqueue_spill
+            self._spill_thread = threading.Thread(
+                target=self._spill_worker, daemon=True,
+                name="kv-tier-spill")
+            self._spill_thread.start()
+            logger.info("KV pool disk tier: %.2f GiB at %s",
+                        cfg.kv_pool_disk_bytes / 2**30, root)
         S = cfg.max_num_seqs
         self.slots = [_Slot() for _ in range(S)]
         self.page_tables = np.zeros((S, self.pages_per_seq), np.int32)
@@ -635,6 +665,12 @@ class InferenceEngine:
             "kv_pool_fetched_tokens_total": 0,  # prompt tokens skipped
             "kv_pool_fetch_failures_total": 0,  # fell back to recompute
             "kv_pool_published_total": 0,       # prefixes published locally
+            # tier-3 SSD spill (docs/kv-pool.md "Tier 3: SSD") —
+            # exposed on /metrics only when the disk tier is enabled
+            "kv_tier_host_hits_total": 0,     # local probe hit host RAM
+            "kv_tier_disk_hits_total": 0,     # local probe hit SSD
+            "kv_tier_import_tokens_total": 0,  # prompt tokens skipped
+            "kv_tier_spill_drops_total": 0,   # spill queue full, entry lost
         }
         self._last_deadline_sweep = 0.0
         self._last_export_tick = 0.0
@@ -1834,6 +1870,30 @@ class InferenceEngine:
         if self.devprof is not None:
             self.devprof.start()
 
+    def _enqueue_spill(self, entry) -> None:
+        """``PrefixPageStore.on_evict`` hook, called on whatever thread
+        triggered the eviction (usually the step loop finishing a
+        request).  Only a non-blocking queue put happens here; a full
+        queue drops the victim — always safe, the tier can only ever
+        remove work."""
+        try:
+            self._spill_q.put_nowait(entry)
+        except queue.Full:
+            self.counters["kv_tier_spill_drops_total"] += 1
+
+    def _spill_worker(self) -> None:
+        """Async demotion loop: serialize evicted entries' chunks
+        (which may block on the export's D2H drain) and persist them
+        to the SSD tier, off the step loop."""
+        while True:
+            entry = self._spill_q.get()
+            if entry is None:
+                return
+            try:
+                self.kv_tier.spill(entry)
+            except Exception:
+                logger.exception("kv_tier spill of %s failed", entry.key)
+
     def stop(self):
         if self.devprof is not None:
             self.devprof.stop()
@@ -1841,6 +1901,9 @@ class InferenceEngine:
         self._wake.set()
         if self._thread:
             self._thread.join(timeout=30)
+        if self._spill_thread is not None:
+            self._spill_q.put(None)
+            self._spill_thread.join(timeout=10)
         # fail whatever is still in flight so no client blocks forever
         # in Request.stream() after shutdown (the loop thread is gone;
         # nothing else would ever deliver their end-of-stream sentinel)

@@ -490,6 +490,50 @@ class EngineMetrics:
                       "Prefix fetches that fell back to local recompute",
                       r, fn=lambda: engine.counters.get(
                           "kv_pool_fetch_failures_total", 0))
+            if getattr(engine, "kv_tier", None) is not None:
+                # tier-3 SSD spill (docs/kv-pool.md "Tier 3: SSD"):
+                # families exist ONLY with the disk tier enabled —
+                # same byte-identical-off discipline as the pool
+                tier = engine.kv_tier
+                Gauge("kaito:kv_tier_hits_total",
+                      "Local tiered-probe hits by serving tier", r,
+                      labels=("tier",),
+                      fn=lambda: {
+                          ("host",): float(engine.counters.get(
+                              "kv_tier_host_hits_total", 0)),
+                          ("disk",): float(engine.counters.get(
+                              "kv_tier_disk_hits_total", 0))})
+                Gauge("kaito:kv_tier_entries",
+                      "Prefix entries resident in the SSD tier", r,
+                      fn=lambda: len(tier))
+                Gauge("kaito:kv_tier_bytes_used",
+                      "SSD bytes held by the disk tier (slabs + meta)",
+                      r, fn=lambda: tier.used_bytes)
+                Gauge("kaito:kv_tier_spills_total",
+                      "Host-LRU victims persisted to the SSD tier", r,
+                      fn=lambda: tier.spills_total)
+                Gauge("kaito:kv_tier_evictions_total",
+                      "Entries pruned from the SSD tier by its byte "
+                      "budget", r, fn=lambda: tier.evictions_total)
+                Gauge("kaito:kv_tier_errors_total",
+                      "Corrupt slabs, failed writes, truncated reads "
+                      "in the SSD tier", r,
+                      fn=lambda: tier.errors_total)
+                Gauge("kaito:kv_tier_import_tokens_total",
+                      "Prompt tokens imported from the local host/SSD "
+                      "tiers instead of recomputed", r,
+                      fn=lambda: engine.counters.get(
+                          "kv_tier_import_tokens_total", 0))
+                Gauge("kaito:kv_tier_spill_drops_total",
+                      "Evicted entries dropped because the spill queue "
+                      "was full", r,
+                      fn=lambda: engine.counters.get(
+                          "kv_tier_spill_drops_total", 0))
+                Gauge("kaito:kv_tier_disk_read_bytes_per_s",
+                      "Measured EWMA SSD read bandwidth feeding the "
+                      "break-even veto (0 before the first sample)", r,
+                      fn=lambda: (engine.pd_costs.snapshot().get(
+                          "disk_bytes_s") or 0.0))
             if getattr(engine, "async_dispatch", False):
                 # zero-bubble decode loop (docs/decode-loop.md): the
                 # family exists ONLY with the async loop on — the

@@ -412,15 +412,36 @@ class TransformerLM:
 
     def _attn_qkv(self, x: jax.Array, p: dict, positions: jax.Array,
                   window: Optional[jax.Array], lora: Optional[dict] = None,
-                  lora_ids: Optional[jax.Array] = None):
+                  lora_ids: Optional[jax.Array] = None, overlap=None):
         """Project to q/k/v heads with norms+rope applied.
 
         x: [B, T, E]; positions: [B, T] absolute positions.
+
+        ``overlap`` is the engine's (mesh, axis) comm-overlap handle
+        (docs/multichip.md): when set, the COLUMN-parallel q projection
+        — the widest of the three, head-sharded over the TP axis —
+        routes through the pipelined all-gather+matmul ring so the
+        activation gather hides behind the partial dots.  k/v (the
+        narrow kv-head projections) and the rank-r LoRA deltas stay on
+        the plain path, whose collectives are noise next to q's.
         """
         a = self.arch
         B, T, _ = x.shape
         ls = self.lora_scaling
-        q = nn.linear(x, p["q"]) + nn.lora_delta(x, p, "q", ls) \
+        if overlap is not None:
+            from kaito_tpu.engine.ops.overlap_collectives import (
+                ag_matmul_eligible, all_gather_matmul)
+
+            mesh, axis = overlap
+            n = int(mesh.shape[axis])
+            if ag_matmul_eligible(x, p["q"], n):
+                q_proj = all_gather_matmul(x, p["q"], mesh,
+                                           axis_name=axis)
+            else:
+                q_proj = nn.linear(x, p["q"])
+        else:
+            q_proj = nn.linear(x, p["q"])
+        q = q_proj + nn.lora_delta(x, p, "q", ls) \
             + nn.multi_lora_delta(x, lora, "q", lora_ids)
         k = nn.linear(x, p["k"]) + nn.lora_delta(x, p, "k", ls) \
             + nn.multi_lora_delta(x, lora, "k", lora_ids)
@@ -494,8 +515,13 @@ class TransformerLM:
             x = x + attn_out
             h2 = self._norm(x, p, "mlp_norm")
             return x + self._mlp(h2, p, moe), ck, cv, ks, vs
+        # collective-compute overlap (docs/multichip.md): DECODE-only,
+        # resolved once here — q (column-parallel, below), o and down
+        # (row-parallel, further down) all key off the same handle
+        ov = self.overlap if mode == "decode" else None
         q, k_new, v_new = self._attn_qkv(h, p, positions, window,
-                                         lora=lora, lora_ids=lora_ids)
+                                         lora=lora, lora_ids=lora_ids,
+                                         overlap=ov)
         ps = ck.shape[-3]
 
         if mode == "prefill_cp":
@@ -629,7 +655,6 @@ class TransformerLM:
         # step's row-parallel attention-out projection routes through
         # the pipelined ring; every prefill mode and the gate-off path
         # keep the plain linear (implicit GSPMD all-reduce) unchanged
-        ov = self.overlap if mode == "decode" else None
         if ov is not None:
             from kaito_tpu.engine.ops.overlap_collectives import (
                 overlap_linear)

@@ -284,19 +284,33 @@ def mlp(x: jax.Array, p: dict, arch: ModelArch, lora_scaling: float = 0.0,
     the one whose output all-reduce sits on the TP decode critical
     path — routes through the pipelined ring instead of the implicit
     GSPMD collective, with ``pf_down`` (the next layer's quantized
-    down slab) riding the same call as the layer-ahead prefetch.  The
+    down slab) riding the same call as the layer-ahead prefetch, and
+    the COLUMN-parallel gate/up projections route through the
+    pipelined all-gather+matmul ring (plain 2-D weights only).  The
     LoRA deltas stay on the plain path: they are rank-r rescues whose
     collectives are noise next to the main projection's.
     """
+    def _col(w):
+        """Column-parallel projection: ring when overlapped+eligible,
+        plain linear (implicit GSPMD collectives) otherwise."""
+        if overlap is not None:
+            from kaito_tpu.engine.ops.overlap_collectives import (
+                ag_matmul_eligible, all_gather_matmul)
+
+            mesh, axis = overlap
+            if ag_matmul_eligible(x, w, int(mesh.shape[axis])):
+                return all_gather_matmul(x, w, mesh, axis_name=axis)
+        return linear(x, w)
+
     if arch.gated_mlp:
-        gate = activation(linear(x, p["gate"]) + lora_delta(x, p, "gate", lora_scaling)
+        gate = activation(_col(p["gate"]) + lora_delta(x, p, "gate", lora_scaling)
                           + multi_lora_delta(x, serve_lora, "gate", lora_ids),
                           arch.hidden_act)
-        up = linear(x, p["up"]) + lora_delta(x, p, "up", lora_scaling) \
+        up = _col(p["up"]) + lora_delta(x, p, "up", lora_scaling) \
             + multi_lora_delta(x, serve_lora, "up", lora_ids)
         h = gate * up
     else:
-        h = linear(x, p["up"]) + lora_delta(x, p, "up", lora_scaling) \
+        h = _col(p["up"]) + lora_delta(x, p, "up", lora_scaling) \
             + multi_lora_delta(x, serve_lora, "up", lora_ids)
         if "up_bias" in p:
             h = h + p["up_bias"]

@@ -63,7 +63,8 @@ from jax.experimental.shard_map import shard_map
 from jax.sharding import PartitionSpec as P
 
 __all__ = [
-    "overlap_linear", "all_gather_matmul", "resolve_mode",
+    "overlap_linear", "all_gather_matmul", "ag_matmul_eligible",
+    "resolve_mode",
     "ring_matmul_reduce_scatter", "ring_all_gather",
     "ring_all_gather_matmul",
 ]
@@ -241,13 +242,28 @@ def overlap_linear(x: jax.Array, w, mesh, *, axis_name: str = "tensor",
                          out_specs=out_spec, check_rep=False)(*operands)
 
 
+def ag_matmul_eligible(x: jax.Array, w, n: int) -> bool:
+    """Can this column-parallel projection route through
+    :func:`all_gather_matmul`?  Plain 2-D weights only — int4 packing
+    ties row slicing to nibble pairs and int8 QTensors carry a scale
+    dict — with the contraction dim K (gathered around the ring) and
+    the out dim N (sharded) both dividing the ring size."""
+    if n <= 1 or isinstance(w, dict) or getattr(w, "ndim", 0) != 2:
+        return False
+    K, N = int(w.shape[0]), int(w.shape[1])
+    return int(x.shape[-1]) == K and K % n == 0 and N % n == 0
+
+
 def all_gather_matmul(x: jax.Array, w: jax.Array, mesh, *,
                       axis_name: str = "tensor") -> jax.Array:
     """Column-parallel pair entry: x [.., K] (sharded on K over the
     ring) @ w [K, N] (sharded on N) -> [.., N] with the x all-gather
     hidden behind the partial dots.  Output stays out-sharded under
     GSPMD (the caller's next op decides whether it ever materializes
-    replicated)."""
+    replicated).  Like ``overlap_linear``, KAITO_COMM_OVERLAP=jax
+    swaps the body for the pure-lax reference (gather, then one dense
+    matmul) at trace time."""
+    mode = resolve_mode()
     n = int(mesh.shape[axis_name])
     lead = x.ndim - 1
     x_spec = P(*([None] * lead + [axis_name]))
@@ -255,9 +271,13 @@ def all_gather_matmul(x: jax.Array, w: jax.Array, mesh, *,
     out_spec = P(*([None] * lead + [axis_name]))
 
     def body(xl, wl):
+        if mode == "jax":
+            xg = jax.lax.all_gather(xl, axis_name, axis=xl.ndim - 1,
+                                    tiled=True)
+            return xg @ wl
         return ring_all_gather_matmul(xl, wl, axis_name=axis_name,
                                       axis_size=n)
 
-    with jax.named_scope("comm_overlap_ag_matmul"):
+    with jax.named_scope(f"comm_overlap_ag_matmul_{mode}"):
         return shard_map(body, mesh=mesh, in_specs=(x_spec, w_spec),
                          out_specs=out_spec, check_rep=False)(x, w)

@@ -716,8 +716,10 @@ class TransferCostModel:
         self._lock = threading.Lock()
         self.net_bytes_s: Optional[float] = None
         self.prefill_tok_s: Optional[float] = None
+        self.disk_bytes_s: Optional[float] = None
         self.transfer_samples = 0
         self.prefill_samples = 0
+        self.disk_samples = 0
 
     def _ewma(self, cur: Optional[float], x: float) -> float:
         return x if cur is None else (1 - self.alpha) * cur + self.alpha * x
@@ -738,12 +740,25 @@ class TransferCostModel:
                                             tokens / seconds)
             self.prefill_samples += 1
 
+    def note_disk_read(self, nbytes: int, seconds: float) -> None:
+        """Calibrate the SSD tier's effective read bandwidth from a
+        completed slab read (chunk bytes / wall seconds, including
+        page-cache effects — the rate the break-even actually sees)."""
+        if nbytes <= 0 or seconds <= 1e-6:
+            return
+        with self._lock:
+            self.disk_bytes_s = self._ewma(self.disk_bytes_s,
+                                           nbytes / seconds)
+            self.disk_samples += 1
+
     def snapshot(self) -> dict:
         with self._lock:
             return {"net_bytes_s": self.net_bytes_s,
                     "prefill_tok_s": self.prefill_tok_s,
+                    "disk_bytes_s": self.disk_bytes_s,
                     "transfer_samples": self.transfer_samples,
-                    "prefill_samples": self.prefill_samples}
+                    "prefill_samples": self.prefill_samples,
+                    "disk_samples": self.disk_samples}
 
 def estimate_params(arch) -> int:
     """Approximate parameter count from the architecture dims (embed +
@@ -796,6 +811,24 @@ def transfer_cost(n_tokens: int, arch, dtype_bytes: int = 2, *,
 def should_transfer(n_tokens: int, arch, dtype_bytes: int = 2, **kw) -> bool:
     c = transfer_cost(n_tokens, arch, dtype_bytes, **kw)
     return c["transfer_s"] < c["recompute_s"]
+
+
+def should_import_from_disk(nbytes: int, n_tokens: int,
+                            measured: Optional[TransferCostModel]) -> bool:
+    """Break-even for the SSD tier: import unless BOTH the disk read
+    rate and the prefill rate have real samples AND the measured read
+    time exceeds the measured recompute time.  Same measured-rates-only
+    veto discipline as the remote fetch path — priors never veto,
+    because a wrong prior silently disabling the tier is worse than an
+    occasional slow read (the read overlaps the scheduler anyway)."""
+    if measured is None:
+        return True
+    m = measured.snapshot()
+    if not (m.get("disk_bytes_s") and m.get("prefill_tok_s")):
+        return True
+    read_s = nbytes / m["disk_bytes_s"]
+    recompute_s = n_tokens / m["prefill_tok_s"]
+    return read_s < recompute_s
 
 
 # ---------------------------------------------------------------------------

@@ -706,9 +706,14 @@ class OpenAIHandler(BaseHTTPRequestHandler):
         from kaito_tpu.engine.kv_pool import pool_block_chars
 
         ps = self.state.engine.cfg.page_size
+        cap = int(getattr(self.state.engine.cfg, "kv_pool_advert_max", 0))
+        total = len(pool)
+        entries = pool.advert(max_entries=cap)
         self._json(200, {"enabled": True, "page_size": ps,
                          "block_chars": pool_block_chars(ps),
-                         "entries": pool.advert()})
+                         "total": total,
+                         "capped": bool(cap and total > len(entries)),
+                         "entries": entries})
 
     def _kv_pool_meta(self, key: str):
         """Fetch handshake: chunk plans plus the entry's EXACT prompt
@@ -965,6 +970,129 @@ class OpenAIHandler(BaseHTTPRequestHandler):
 
         threading.Thread(target=pull, daemon=True,
                          name="kv-pool-puller").start()
+        return req
+
+    def _submit_with_local_tier(self, tokens: list, params, *,
+                                timeout_s: float = 0.0, tenant: str = "",
+                                priority: str = "", adapter: str = "",
+                                pool_blocks=None):
+        """Local tiered probe (docs/kv-pool.md "Tier 3: SSD"): before
+        asking a remote peer or recomputing, check whether THIS
+        replica already holds the prompt's prefix — in the host-RAM
+        pool store (tier 2) or demoted to the SSD slab directory
+        (tier 3).  Runs only when the disk tier is enabled; returns
+        None on any ineligibility or miss and the caller falls through
+        to the remote-fetch hint / plain submit."""
+        eng = self.state.engine
+        tier = getattr(eng, "kv_tier", None)
+        pool = getattr(eng, "kv_pool", None)
+        if tier is None or pool is None or not pool_blocks:
+            return None
+
+        from kaito_tpu.engine.kv_pool import common_prefix_pages, pool_key
+        from kaito_tpu.engine.pd import ChunkPlan, should_import_from_disk
+
+        ps = eng.cfg.page_size
+        costs = getattr(eng, "pd_costs", None)
+
+        def _submit(meta, plans, n_prefix):
+            return eng.submit_with_kv_prefix(
+                tokens, meta, plans, n_prefix, params,
+                req_id=f"cmpl-{uuid.uuid4().hex[:20]}",
+                timeout_s=timeout_s, trace_id=self._rid,
+                tenant=tenant, priority=priority, adapter=adapter,
+                pool_blocks=pool_blocks)
+
+        # -- tier 2: host-RAM store, longest resident prefix of the
+        # request's block chain.  peek() during the scan (no hit/miss
+        # skew); one get() on the chosen key registers the hit and the
+        # LRU touch, same accounting a remote meta handshake gets.
+        entry = None
+        for n in range(len(pool_blocks), 0, -1):
+            e = pool.peek(pool_key(pool_blocks[:n]))
+            if e is not None:
+                entry = e
+                break
+        if entry is not None:
+            exp = entry.export
+            n_pages = common_prefix_pages(tokens, exp.prompt_tokens, ps)
+            if n_pages > 0:
+                n_prefix = n_pages * ps
+                try:
+                    req = _submit(exp.meta, exp.plans, n_prefix)
+                except ValueError as e:
+                    logger.info("kv_tier host import rejected: %s", e)
+                    return None
+                pool.get(entry.key)
+                eng.counters["kv_tier_host_hits_total"] += 1
+                eng.counters["kv_tier_import_tokens_total"] += n_prefix
+
+                def feed_host():
+                    ci = req.kv_chunked
+                    try:
+                        exp.ensure_draining()
+                        for i in range(len(exp.plans)):
+                            # consume=False: pool entries serve many
+                            # readers (the /chunk endpoint contract)
+                            ci.feed(i, exp.get_chunk(i, consume=False))
+                            eng._wake.set()
+                    except Exception as e:
+                        ci.set_error(f"host tier feed failed: {e}",
+                                     transient=True)
+                        eng._wake.set()
+
+                threading.Thread(target=feed_host, daemon=True,
+                                 name="kv-tier-host-feeder").start()
+                return req
+
+        # -- tier 3: SSD slab directory
+        hit = tier.lookup_longest(pool_blocks)
+        if hit is None:
+            return None
+        key, dmeta = hit
+        meta = dmeta["meta"]
+        entry_tokens = dmeta.get("prompt_tokens") or []
+        n_pages = common_prefix_pages(tokens, entry_tokens, ps)
+        if n_pages <= 0:
+            return None
+        n_prefix = n_pages * ps
+        nbytes = sum(int(s) for s in dmeta["chunk_sizes"])
+        # break-even: measured SSD read rate vs measured prefill rate;
+        # priors never veto (same discipline as the remote fetch path)
+        if not should_import_from_disk(nbytes, n_prefix, costs):
+            logger.info("kv_tier disk read below measured break-even "
+                        "(%d tokens); recomputing locally", n_prefix)
+            return None
+        try:
+            plans = [ChunkPlan.from_json(c) for c in meta["chunks"]]
+            req = _submit(meta, plans, n_prefix)
+        except (KeyError, ValueError) as e:
+            logger.info("kv_tier disk import rejected: %s", e)
+            return None
+        eng.counters["kv_tier_disk_hits_total"] += 1
+        eng.counters["kv_tier_import_tokens_total"] += n_prefix
+
+        def feed_disk():
+            ci = req.kv_chunked
+            try:
+                t0 = time.monotonic()
+                fed = 0
+                for i in range(len(plans)):
+                    data = tier.read_chunk(key, i, dmeta)
+                    fed += len(data)
+                    ci.feed(i, data)
+                    eng._wake.set()
+                if costs is not None:
+                    costs.note_disk_read(fed, time.monotonic() - t0)
+            except Exception as e:
+                # corrupt/truncated slab → the engine's prefix-import
+                # error path falls back to a clean full local prefill
+                ci.set_error(f"disk tier read of {key} failed: {e}",
+                             transient=True)
+                eng._wake.set()
+
+        threading.Thread(target=feed_disk, daemon=True,
+                         name="kv-tier-disk-feeder").start()
         return req
 
     def _adopt_handoff_trace(self, meta: dict) -> None:
@@ -1514,9 +1642,17 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                 tokens = req.prompt_tokens
             else:
                 req = None
+                if getattr(st.engine, "kv_tier", None) is not None:
+                    # tier-3 enabled: probe the LOCAL host/SSD tiers
+                    # before any remote peer and before recompute
+                    req = self._submit_with_local_tier(
+                        tokens, params, timeout_s=timeout_s,
+                        tenant=tenant, priority=priority,
+                        adapter=adapter, pool_blocks=pool_blocks)
                 fetch_url = self.headers.get("X-Kaito-KV-Fetch", "")
                 fetch_key = self.headers.get("X-Kaito-KV-Fetch-Key", "")
-                if (getattr(st.engine, "kv_pool", None) is not None
+                if (req is None
+                        and getattr(st.engine, "kv_pool", None) is not None
                         and fetch_url and fetch_key):
                     # the EPP routed here with a fetch hint: a peer
                     # replica holds this prompt's prefix KV.  Adapter
@@ -1537,6 +1673,12 @@ class OpenAIHandler(BaseHTTPRequestHandler):
                         priority=priority, pool_blocks=pool_blocks)
         except ValueError as e:
             return self._error(400, str(e))
+        # conversation identity (docs/routing.md "Session affinity"):
+        # opaque client id the EPP pins turn N to turn N-1's holder
+        # with; carried on the Request for tracing/debug parity
+        session = self.headers.get("X-Kaito-Session", "").strip()
+        if session:
+            req.session = session[:128]
 
         # extra choices decode CONCURRENTLY with the first (one engine
         # request per choice, seeds offset from the pinned primary seed
@@ -1982,6 +2124,24 @@ def main(argv=None):
                     default=int(os.environ.get("KAITO_KV_POOL_BYTES",
                                                str(1 << 30))),
                     help="host bytes for the replica-local prefix store")
+    ap.add_argument("--kv-pool-disk-bytes", type=int,
+                    default=int(os.environ.get("KAITO_KV_POOL_DISK_BYTES",
+                                               "0")),
+                    help="tier-3 SSD budget under the pool (docs/"
+                         "kv-pool.md \"Tier 3: SSD\"): host-LRU victims "
+                         "demote to a bounded slab directory and misses "
+                         "probe it before remote peers (0 = no disk "
+                         "tier; off keeps behavior and /metrics "
+                         "byte-identical)")
+    ap.add_argument("--kv-pool-disk-dir",
+                    default=os.environ.get("KAITO_KV_POOL_DISK_DIR", ""),
+                    help="slab directory for the SSD tier ('' = "
+                         "<tempdir>/kaito-kv-tier)")
+    ap.add_argument("--kv-pool-advert-max", type=int,
+                    default=int(os.environ.get("KAITO_KV_POOL_ADVERT_MAX",
+                                               "0")),
+                    help="cap /debug/kv_pool adverts to the freshest N "
+                         "entries per EPP scrape (0 = unlimited)")
     ap.add_argument("--async-dispatch", action="store_true",
                     default=os.environ.get("KAITO_ASYNC_DISPATCH", "")
                     in ("1", "true"),
@@ -2159,6 +2319,9 @@ def main(argv=None):
         pd_source_allowlist=args.pd_source_allowlist,
         kv_pool_enabled=args.kv_pool,
         kv_pool_bytes=args.kv_pool_bytes,
+        kv_pool_disk_bytes=args.kv_pool_disk_bytes,
+        kv_pool_disk_dir=args.kv_pool_disk_dir,
+        kv_pool_advert_max=args.kv_pool_advert_max,
         async_dispatch=args.async_dispatch,
         comm_overlap=args.comm_overlap,
         disable_rate_limit=args.kaito_disable_rate_limit,

@@ -212,6 +212,43 @@ def parse_devprof_annotation(text: str) -> Optional[float]:
     return interval
 
 
+def parse_kv_pool_disk_annotation(disk_text: str,
+                                  kv_pool_text: str = "") -> Optional[int]:
+    """Parse the ``kaito-tpu.io/kv-pool-disk`` Workspace annotation
+    (docs/kv-pool.md "Tier 3: SSD"): the byte budget for the pool's
+    disk spill tier.  Empty input returns None — the server keeps its
+    default (no disk tier), so an absent annotation leaves the pod
+    command, spill behavior, and metrics exposition byte-identical.
+    Accepts a Kubernetes resource quantity (``20Gi``, ``500M``) or
+    plain bytes; ``0``/``off``/``false`` return None too, an explicit
+    way to keep the tier off.  The tier holds spill from the cluster
+    pool's host store, so naming a budget without
+    ``kaito-tpu.io/kv-pool`` enabled is an error.  Raises ValueError
+    on anything else; the workspace controller calls this at plan time
+    so a bad annotation becomes a PlanFailed condition instead of a
+    crash-looping pod.  jax-free on purpose: the controller imports
+    it."""
+    text = (disk_text or "").strip()
+    if not text or text.lower() in ("off", "false", "0"):
+        return None
+    from kaito_tpu.utils.quantity import parse_quantity
+    try:
+        nbytes = parse_quantity(text)
+    except ValueError:
+        raise ValueError(
+            f"kv-pool-disk annotation must be a byte quantity "
+            f"(e.g. '20Gi') or 'off', got {text!r}") from None
+    if nbytes <= 0:
+        return None
+    if (kv_pool_text or "").strip().lower() not in ("true", "1", "on",
+                                                    "enabled"):
+        raise ValueError(
+            "kv-pool-disk requires kaito-tpu.io/kv-pool enabled — the "
+            "SSD tier spills the cluster pool's host store and is "
+            "inert without it")
+    return nbytes
+
+
 def parse_comm_overlap_annotation(text: str) -> Optional[bool]:
     """Parse the ``kaito-tpu.io/comm-overlap`` Workspace annotation
     (docs/multichip.md): the collective-compute overlap gate for TP
@@ -362,6 +399,14 @@ def build_engine_command(
             "kaito-tpu.io/kv-pool-bytes", "")
         if pool_bytes:
             args += ["--kv-pool-bytes", pool_bytes]
+        # tier-3 SSD spill (docs/kv-pool.md "Tier 3: SSD"): renders
+        # only inside the kv-pool branch — the validated parse below
+        # already rejects a disk budget without the pool
+        disk = parse_kv_pool_disk_annotation(
+            ws.metadata.annotations.get("kaito-tpu.io/kv-pool-disk", ""),
+            kv_pool)
+        if disk is not None:
+            args += ["--kv-pool-disk-bytes", str(disk)]
     spec_draft = ws.metadata.annotations.get(
         "kaito-tpu.io/speculative-draft", "")
     if spec_draft:

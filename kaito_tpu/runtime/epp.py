@@ -28,6 +28,7 @@ The picker exports its own ``kaito:epp_*`` series next to the shared
 from __future__ import annotations
 
 import argparse
+import collections
 import http.client
 import json
 import logging
@@ -86,9 +87,16 @@ class KVPoolIndex:
     Rows are keyed by (block_chars, hash) so adverts from replicas
     configured with a different page size can never cross-match."""
 
+    # retained rows per URL when a replica sends CAPPED adverts (the
+    # merge path below never wholesale-replaces, so bound what a
+    # long-lived replica can accumulate in the index)
+    MAX_ENTRIES_PER_URL = 4096
+
     def __init__(self):
         self._lock = threading.Lock()
-        self._adverts: dict[str, dict] = {}     # url -> parsed advert
+        # url -> {"block_chars": int, "entries": OrderedDict key->entry
+        # (freshest LAST)}
+        self._adverts: dict[str, dict] = {}
         # (block_chars, hash hex) -> url -> (entry key, n_pages, n_tokens)
         self._index: dict = {}
         self.updates = 0
@@ -98,17 +106,44 @@ class KVPoolIndex:
             return len(self._index)
 
     def update(self, url: str, advert: Optional[dict]) -> None:
-        """Replace one replica's advert (None/empty/disabled = forget
+        """Fold one replica's advert (None/empty/disabled = forget
         it — a scrape failure or a rollout restart must not leave
         stale holders steering fetches at a replica without the KV;
         the fetch path degrades to recompute anyway, this just keeps
-        the hint hit rate honest)."""
+        the hint hit rate honest).
+
+        A FULL advert wholesale-replaces the replica's rows.  A CAPPED
+        advert (``"capped": true`` — the store listed only its
+        freshest N entries) is authoritative only for the rows it
+        lists: listed keys are refreshed/added, unlisted rows are
+        retained (bounded by ``MAX_ENTRIES_PER_URL``) — an evicted
+        retained row just degrades a later fetch to an ordinary
+        miss."""
         with self._lock:
             if (isinstance(advert, dict) and advert.get("enabled")
                     and advert.get("entries")):
-                self._adverts[url] = {
-                    "block_chars": int(advert.get("block_chars") or 0),
-                    "entries": advert["entries"]}
+                bc = int(advert.get("block_chars") or 0)
+                # the wire lists freshest FIRST; key the rows freshest
+                # LAST so popitem(last=False) ages out the stalest
+                fresh: "collections.OrderedDict[str, dict]" = \
+                    collections.OrderedDict(
+                        (str(e.get("key") or ""), e)
+                        for e in reversed(advert["entries"])
+                        if e.get("key"))
+                prev = self._adverts.get(url)
+                if (advert.get("capped") and prev is not None
+                        and prev["block_chars"] == bc):
+                    merged = prev["entries"]
+                    for k, e in fresh.items():
+                        merged.pop(k, None)
+                        merged[k] = e
+                    while len(merged) > self.MAX_ENTRIES_PER_URL:
+                        merged.popitem(last=False)
+                    entries = merged
+                else:
+                    entries = fresh
+                self._adverts[url] = {"block_chars": bc,
+                                      "entries": entries}
             else:
                 self._adverts.pop(url, None)
             self._rebuild_locked()
@@ -121,7 +156,7 @@ class KVPoolIndex:
         idx: dict = {}
         for url, adv in self._adverts.items():
             bc = adv["block_chars"]
-            for e in adv["entries"]:
+            for e in adv["entries"].values():
                 blocks = e.get("blocks") or []
                 key = str(e.get("key") or "")
                 n_tokens = int(e.get("n_tokens") or 0)
@@ -306,7 +341,7 @@ class RequestCtx:
 
     __slots__ = ("blocks", "matched", "kv_source", "want_role", "steered",
                  "tenant", "priority", "pool_match", "adapter",
-                 "adapter_residency")
+                 "adapter_residency", "session")
 
     def __init__(self):
         self.blocks: list[int] = []            # prompt prefix block hashes
@@ -316,6 +351,7 @@ class RequestCtx:
         self.steered = False                   # PD locality won the pick
         self.tenant: str = ""                  # X-Kaito-Tenant (QoS)
         self.priority: str = ""                # X-Kaito-Priority class name
+        self.session: str = ""                 # X-Kaito-Session conv id
         # cluster KV pool: url -> (entry key, matched pages, entry tokens)
         self.pool_match: dict[str, tuple] = {}
         self.adapter: str = ""                 # resolved LoRA adapter name
@@ -432,6 +468,21 @@ class EndpointPicker(RoutingCore):
                   "Distinct (block_chars, block hash) rows in the "
                   "cluster prefix->holder index", r,
                   fn=lambda: float(len(self.pool_index)))
+            # session affinity (docs/routing.md "Session affinity"):
+            # conversation-keyed pin so turn N lands on the replica
+            # whose host/SSD KV tiers hold turn N-1's pages — gated
+            # with the pool (no pool, no tiered KV worth pinning to)
+            self.m_session_pin_routed = Counter(
+                "kaito:epp_session_pin_routed_total",
+                "Requests routed to their conversation's pinned holder "
+                "(X-Kaito-Session)", r)
+            self.m_session_pin_misses = Counter(
+                "kaito:epp_session_pin_misses_total",
+                "Session-tagged requests whose pinned holder was gone "
+                "or unusable (fell back to prefix scoring)", r)
+            Gauge("kaito:epp_session_pins",
+                  "Conversations currently pinned to a holder", r,
+                  fn=lambda: float(self.index.session_count()))
         if adapter_affinity:
             self.m_adapter_hits = Counter(
                 "kaito:epp_adapter_affinity_hits_total",
@@ -471,6 +522,7 @@ class EndpointPicker(RoutingCore):
             # matching the engine server's contract)
             ctx.tenant = (headers.get("X-Kaito-Tenant") or "").strip()
             ctx.priority = (headers.get("X-Kaito-Priority") or "").strip()
+            ctx.session = (headers.get("X-Kaito-Session") or "").strip()
         if method != "POST":
             return ctx
         if path.startswith("/pd/prefill"):
@@ -644,6 +696,21 @@ class EndpointPicker(RoutingCore):
         # non-demoted peer regardless of score (healthy but shedding)
         alive.sort(key=lambda b: (b.demoted, -self._score(b, ctx),
                                   b.load.waiting))
+        # session pin (docs/routing.md "Session affinity"): a
+        # conversation's turn N goes to the replica that served turn
+        # N-1 — its HBM radix tree / host store / SSD tier hold the
+        # history — ahead of prefix scoring.  A gone, saturated,
+        # breaker-open, or shedding holder forfeits the pin and the
+        # scored order stands (the holder's tiers are useless if the
+        # request would just queue behind them).
+        if ctx.session and self.pool_index is not None and alive:
+            pinned = self.index.session_holder(ctx.session)
+            if pinned:
+                for i, b in enumerate(alive):
+                    if (b.url == pinned and b.state == "closed"
+                            and not b.saturated and not b.demoted):
+                        alive.insert(0, alive.pop(i))
+                        break
         for b in alive + draining + dead:
             with self._lock:
                 b.served += 1
@@ -667,6 +734,17 @@ class EndpointPicker(RoutingCore):
                 self.m_pool_route.inc()
             elif self.request_headers(ctx, backend):
                 self.m_pool_fetch.inc()
+        if ctx.session and self.pool_index is not None:
+            holder = self.index.session_holder(ctx.session)
+            if holder == backend.url:
+                self.m_session_pin_routed.inc()
+            elif holder is not None:
+                self.m_session_pin_misses.inc()
+            # re-pin to whoever actually served the turn (first turn
+            # creates the pin; a failover moves it) — never onto a
+            # draining replica whose tiers are about to vanish
+            if status < 500 and not backend.draining:
+                self.index.record_session(ctx.session, backend.url)
         if ctx.adapter and self.adapter_index is not None:
             if ctx.adapter_residency.get(backend.url, 0.0) > 0:
                 self.m_adapter_hits.inc()

@@ -97,6 +97,10 @@ _ENGINE_GAUGES = {
     # since process start, present only with --flight-dir — the summed
     # fold feeds the controller's FlightRecorded Event
     "kaito:flight_bundles_total": ("flight_bundles", "sum"),
+    # tier-3 SSD KV (docs/kv-pool.md "Tier 3: SSD"): present only on
+    # replicas running with --kv-pool-disk-bytes > 0
+    "kaito:kv_tier_entries": ("kv_tier_entries", "sum"),
+    "kaito:kv_tier_bytes_used": ("kv_tier_bytes", "sum"),
 }
 # cumulative counters -> per-replica delta rates at fold time
 _ENGINE_COUNTERS = {
@@ -115,6 +119,12 @@ _ENGINE_COUNTERS = {
     "kaito:adapter_hits_total": "adapter_hits_total",
     "kaito:grammar_cache_hits_total": "grammar_hits_total",
     "kaito:grammar_cache_misses_total": "grammar_misses_total",
+    # tier-3 SSD KV (docs/kv-pool.md "Tier 3: SSD"): the labelled
+    # hits family (tier="host"|"disk") sums across labels into one
+    # local-tier hit counter; spills/evictions judge churn
+    "kaito:kv_tier_hits_total": "kv_tier_hits_total",
+    "kaito:kv_tier_spills_total": "kv_tier_spills_total",
+    "kaito:kv_tier_evictions_total": "kv_tier_evictions_total",
     # packed prefill (docs/prefill.md): histogram _sum/_count fold into
     # plain counters (a fleet-level histogram merge would need every
     # bucket edge; mean pack size + dispatch rate answer the capacity
@@ -668,6 +678,8 @@ class FleetTelemetry:
                 "spec_proposed_total", "spec_accepted_total",
                 "host_kv_hits_total", "host_kv_misses_total",
                 "host_kv_evictions_total",
+                "kv_tier_hits_total", "kv_tier_spills_total",
+                "kv_tier_evictions_total",
                 "adapter_loads_total", "adapter_evictions_total",
                 "adapter_hits_total",
                 "grammar_hits_total", "grammar_misses_total",
@@ -841,6 +853,14 @@ class FleetTelemetry:
             "host_kv_evictions_rate": rate("host_kv_evictions_rate"),
             "host_kv_hit_rate": (hkv_hit / (hkv_hit + hkv_miss)
                                  if hkv_hit + hkv_miss > 0 else 0.0),
+            # tier-3 SSD KV (docs/kv-pool.md "Tier 3: SSD"): capacity
+            # (entries/bytes across replicas running the tier), local
+            # tiered-probe hit rate, and demotion/prune churn
+            "kv_tier_entries": fold("kv_tier_entries", "sum"),
+            "kv_tier_bytes": fold("kv_tier_bytes", "sum"),
+            "kv_tier_hits_rate": rate("kv_tier_hits_rate"),
+            "kv_tier_spills_rate": rate("kv_tier_spills_rate"),
+            "kv_tier_evictions_rate": rate("kv_tier_evictions_rate"),
             # multi-LoRA adapter plane (docs/multi-lora.md): residency
             # vs capacity (is the slot table sized right?), hot-load +
             # eviction churn, and per-request adapter traffic
@@ -1135,6 +1155,23 @@ class FleetTelemetry:
         Gauge("kaito:fleet_host_kv_hit_rate",
               "Fleet host KV offload hit ratio (rate-weighted)", r,
               labels=("kind", "name"), fn=family("host_kv_hit_rate"))
+        Gauge("kaito:fleet_kv_tier_entries",
+              "SSD KV tier entries summed over the fleet", r,
+              labels=("kind", "name"), fn=family("kv_tier_entries"))
+        Gauge("kaito:fleet_kv_tier_bytes",
+              "SSD KV tier bytes summed over the fleet", r,
+              labels=("kind", "name"), fn=family("kv_tier_bytes"))
+        Gauge("kaito:fleet_kv_tier_hits_per_s",
+              "Fleet rate of prefix imports served from the local "
+              "host/SSD tiers", r,
+              labels=("kind", "name"), fn=family("kv_tier_hits_rate"))
+        Gauge("kaito:fleet_kv_tier_spills_per_s",
+              "Fleet rate of host-LRU victims demoted to SSD", r,
+              labels=("kind", "name"), fn=family("kv_tier_spills_rate"))
+        Gauge("kaito:fleet_kv_tier_evictions_per_s",
+              "Fleet rate of SSD-tier budget prunes (churn)", r,
+              labels=("kind", "name"),
+              fn=family("kv_tier_evictions_rate"))
         Gauge("kaito:fleet_adapter_resident",
               "LoRA adapters resident in HBM slots, fleet-wide", r,
               labels=("kind", "name"), fn=family("adapter_resident"))

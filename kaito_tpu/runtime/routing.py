@@ -267,14 +267,23 @@ class PrefixAffinityIndex:
     Capacity bounds total distinct block hashes; eviction is LRU so a
     hot shared prefix never ages out while it keeps hitting."""
 
-    def __init__(self, capacity: int = 65536):
+    def __init__(self, capacity: int = 65536,
+                 session_capacity: int = 16384):
         if capacity <= 0:
             raise ValueError("capacity must be positive")
         self.capacity = capacity
+        self.session_capacity = session_capacity
         self.evictions = 0
         self._lock = threading.Lock()
         # block hash -> {backend_url: last_touch} (insertion order = LRU)
         self._map: OrderedDict[int, dict[str, float]] = OrderedDict()
+        # session id -> backend url (insertion order = LRU): the
+        # conversation-keyed pin (docs/routing.md "Session affinity").
+        # Turn N of a conversation routes to the replica that served
+        # turn N-1 — whose host/SSD KV tiers hold the history — before
+        # prefix scoring gets a say; a dead/removed holder falls back
+        # to normal scoring via drop_backend.
+        self._sessions: OrderedDict[str, str] = OrderedDict()
 
     def __len__(self) -> int:
         with self._lock:
@@ -315,6 +324,30 @@ class PrefixAffinityIndex:
                     out[url] = out.get(url, 0) + 1
         return out
 
+    def record_session(self, session: str, backend_url: str) -> None:
+        """Pin a conversation to the replica that just served it."""
+        if not session:
+            return
+        with self._lock:
+            self._sessions[session] = backend_url
+            self._sessions.move_to_end(session)
+            while len(self._sessions) > self.session_capacity:
+                self._sessions.popitem(last=False)
+
+    def session_holder(self, session: str) -> Optional[str]:
+        """The pinned holder url for a conversation, or None."""
+        if not session:
+            return None
+        with self._lock:
+            url = self._sessions.get(session)
+            if url is not None:
+                self._sessions.move_to_end(session)
+            return url
+
+    def session_count(self) -> int:
+        with self._lock:
+            return len(self._sessions)
+
     def drop_backend(self, backend_url: str) -> None:
         """Forget a replica (removed from the pool / restarted — its
         KV cache is gone, affinity to it is stale)."""
@@ -326,6 +359,10 @@ class PrefixAffinityIndex:
                     empty.append(h)
             for h in empty:
                 del self._map[h]
+            stale = [s for s, url in self._sessions.items()
+                     if url == backend_url]
+            for s in stale:
+                del self._sessions[s]
 
 
 # ---------------------------------------------------------------------------

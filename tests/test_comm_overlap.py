@@ -90,6 +90,37 @@ def test_all_gather_matmul_matches_dense(cpu_devices):
                                rtol=2e-4, atol=2e-4)
 
 
+def test_all_gather_matmul_jax_reference_mode(cpu_devices, monkeypatch):
+    """KAITO_COMM_OVERLAP=jax swaps the hand-rolled ring for the
+    framework all-gather in the COLUMN-parallel primitive too — same
+    numbers, different schedule (the A/B lever works on both ends)."""
+    monkeypatch.setenv("KAITO_COMM_OVERLAP", "jax")
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal((2, 32)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((32, 48)), jnp.float32)
+    out = all_gather_matmul(x, w, _mesh(4))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x @ w),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ag_matmul_eligible_gating(cpu_devices):
+    """The q/gate/up wiring keys off ``ag_matmul_eligible``: plain 2-D
+    weights with both dims divisible by the mesh only — QTensor dicts
+    (int4/int8) and LoRA-delta shapes stay on the unoverlapped path."""
+    from kaito_tpu.engine.ops.overlap_collectives import ag_matmul_eligible
+
+    x = jnp.ones((2, 32), jnp.float32)
+    w = jnp.ones((32, 48), jnp.float32)
+    assert ag_matmul_eligible(x, w, 4)
+    assert not ag_matmul_eligible(x, w, 1)            # no TP axis
+    assert not ag_matmul_eligible(x, {"q8": w}, 4)    # quantized dict
+    assert not ag_matmul_eligible(x, jnp.ones((32, 50)), 4)  # N % n
+    assert not ag_matmul_eligible(x, jnp.ones((30, 48)), 4)  # K mismatch
+    assert not ag_matmul_eligible(jnp.ones((2, 30)), jnp.ones((30, 48)),
+                                  4)                  # K % n
+    assert not ag_matmul_eligible(x, jnp.ones((32,)), 4)     # not 2-D
+
+
 def test_quantized_ring_parity(cpu_devices):
     """QTensor weights ride the ring: int8 (per-out-channel scale) and
     int4 (per-group scale, groups along K so each shard owns whole
